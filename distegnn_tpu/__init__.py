@@ -1,0 +1,21 @@
+"""distegnn_tpu — a TPU-native framework for fast & distributed equivariant GNNs.
+
+A from-scratch JAX/XLA/pjit implementation of the capabilities of
+GLAD-RUC/DistEGNN ("Fast and Distributed Equivariant Graph Neural Networks by
+Virtual Node Learning", arXiv:2506.19482). Compute path is JAX (jit/shard_map/
+Pallas); graphs are dense batched arrays with static shapes; distribution is a
+`jax.sharding.Mesh` with a `graph` (spatial-partition) axis and XLA collectives
+instead of NCCL.
+
+Layer map (mirrors reference SURVEY.md §1, redesigned TPU-first):
+  L6 CLI/config       distegnn_tpu.config, main.py
+  L5 Training runtime distegnn_tpu.train
+  L4 Models           distegnn_tpu.models
+  L3 Distributed comm distegnn_tpu.parallel (mesh + psum collectives)
+  L2 Data/partition   distegnn_tpu.data
+  L1 Dataset gen      distegnn_tpu.datagen (offline)
+"""
+
+__version__ = "0.1.0"
+
+from distegnn_tpu.ops.graph import GraphBatch  # noqa: F401

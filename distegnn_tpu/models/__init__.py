@@ -1,0 +1,2 @@
+from distegnn_tpu.models.fast_egnn import FastEGNN, EGCLVel  # noqa: F401
+from distegnn_tpu.models.registry import get_model  # noqa: F401

@@ -1,0 +1,99 @@
+"""Shared building blocks for all model families.
+
+Initializer parity notes (vs torch defaults used throughout the reference):
+  - torch nn.Linear default: kaiming_uniform(a=sqrt(5)) == U(+-1/sqrt(fan_in));
+    we match its variance with variance_scaling(1/3, fan_in, uniform).
+  - coordinate heads: xavier_uniform with gain=0.001, no bias (reference
+    models/FastEGNN.py:96-107) — variance_scaling(1e-6, fan_avg, uniform).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional, Sequence
+
+import jax.numpy as jnp
+from flax import linen as nn
+
+# torch nn.Linear default weight init (same variance): U(+-1/sqrt(fan_in))
+torch_linear_init = nn.initializers.variance_scaling(1.0 / 3.0, "fan_in", "uniform")
+# xavier_uniform(gain=0.001): bound = gain*sqrt(6/(fan_in+fan_out)) -> scale = gain^2
+coord_head_init = nn.initializers.variance_scaling(1e-6, "fan_avg", "uniform")
+
+
+def _torch_bias_init(fan_in: int):
+    """torch nn.Linear default bias init: U(+-1/sqrt(fan_in))."""
+    bound = 1.0 / (fan_in ** 0.5)
+    def init(key, shape, dtype=jnp.float32):
+        import jax
+        return jax.random.uniform(key, shape, dtype, minval=-bound, maxval=bound)
+    return init
+
+
+class TorchDense(nn.Module):
+    """Dense with full torch nn.Linear default init parity (weight AND bias)."""
+
+    features: int
+    use_bias: bool = True
+    kernel_init: Optional[Callable] = None
+
+    @nn.compact
+    def __call__(self, x):
+        fan_in = x.shape[-1]
+        return nn.Dense(
+            self.features,
+            use_bias=self.use_bias,
+            kernel_init=self.kernel_init or torch_linear_init,
+            bias_init=_torch_bias_init(fan_in),
+        )(x)
+
+
+class MLP(nn.Module):
+    """Plain MLP: Dense(+act) stack; optionally activation after the last layer."""
+
+    sizes: Sequence[int]
+    act: Callable = nn.silu
+    act_last: bool = False
+    use_bias_last: bool = True
+    kernel_init_last: Optional[Callable] = None
+
+    @nn.compact
+    def __call__(self, x):
+        n = len(self.sizes)
+        for i, size in enumerate(self.sizes):
+            last = i == n - 1
+            x = TorchDense(
+                size,
+                use_bias=self.use_bias_last if last else True,
+                kernel_init=(self.kernel_init_last or torch_linear_init) if last else torch_linear_init,
+            )(x)
+            if not last or self.act_last:
+                x = self.act(x)
+        return x
+
+
+class CoordMLP(nn.Module):
+    """Dense(H) -> act -> Dense(1, no bias, xavier gain 1e-3) [-> tanh].
+
+    The scalar head that turns an invariant message into a displacement
+    magnitude (reference get_coord_mlp, models/FastEGNN.py:96-107)."""
+
+    hidden_nf: int
+    act: Callable = nn.silu
+    tanh: bool = False
+
+    @nn.compact
+    def __call__(self, x):
+        x = TorchDense(self.hidden_nf)(x)
+        x = self.act(x)
+        x = nn.Dense(1, use_bias=False, kernel_init=coord_head_init)(x)
+        if self.tanh:
+            x = jnp.tanh(x)
+        return x
+
+
+def gather_nodes(data: jnp.ndarray, idx: jnp.ndarray) -> jnp.ndarray:
+    """Batched node gather: data [B, N, F], idx [B, E] -> [B, E, F].
+
+    One XLA gather per call — the TPU form of the reference's ``coord[row]``
+    advanced indexing on flat arrays."""
+    return jnp.take_along_axis(data, idx[..., None], axis=1)

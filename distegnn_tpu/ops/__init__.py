@@ -1,0 +1,8 @@
+from distegnn_tpu.ops.segment import (  # noqa: F401
+    segment_sum,
+    segment_mean,
+    masked_mean,
+    masked_sum,
+)
+from distegnn_tpu.ops.graph import GraphBatch, pad_graphs, batch_graphs  # noqa: F401
+from distegnn_tpu.ops.radius import radius_graph_np, full_graph_np, cutoff_edges_np  # noqa: F401

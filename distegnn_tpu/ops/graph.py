@@ -1,0 +1,153 @@
+"""GraphBatch — the static-shape batched graph container.
+
+The reference carries ragged PyG ``Data(x, pos, vel, attr, target, loc_mean,
+edge_index, edge_attr)`` objects concatenated along a flat node axis with a
+``batch`` vector (reference datasets/process_dataset.py:114-115). XLA wants
+static shapes, so we use a dense layout instead:
+
+  node arrays  [B, N, ...]   padded to N = bucketed max nodes, with node_mask
+  edge arrays  [B, E, ...]   padded edge list (local per-graph indices), with
+                             edge_mask; padded edges point at node 0 and are
+                             masked out of every aggregation
+  graph arrays [B, ...]      e.g. loc_mean
+
+This dense layout is what makes the model MXU-friendly: every MLP runs as one
+big [B*N(*C), F] matmul, per-graph reductions are masked means over a fixed N,
+and under the distributed mesh the N axis holds one spatial partition per
+device (see distegnn_tpu.parallel).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import numpy as np
+import jax.numpy as jnp
+from flax import struct
+
+
+@struct.dataclass
+class GraphBatch:
+    """A batch of B padded graphs (or, distributed: B padded graph *partitions*).
+
+    Shapes (F=node features, A=node attrs, D=edge attrs):
+      node_feat [B, N, F] float   node_mask  [B, N]  float 0/1
+      loc       [B, N, 3] float   edge_index [B, 2, E] int32 (row=receiver, col=sender)
+      vel       [B, N, 3] float   edge_attr  [B, E, D] float
+      target    [B, N, 3] float   edge_mask  [B, E] float 0/1
+      node_attr [B, N, A] float (A may be 0)
+      loc_mean  [B, 3]    float — GLOBAL mean of node positions per graph
+                                  (across all partitions when distributed)
+    """
+
+    node_feat: jnp.ndarray
+    node_attr: jnp.ndarray
+    loc: jnp.ndarray
+    vel: jnp.ndarray
+    target: jnp.ndarray
+    loc_mean: jnp.ndarray
+    node_mask: jnp.ndarray
+    edge_index: jnp.ndarray
+    edge_attr: jnp.ndarray
+    edge_mask: jnp.ndarray
+
+    @property
+    def batch_size(self) -> int:
+        return self.node_feat.shape[0]
+
+    @property
+    def max_nodes(self) -> int:
+        return self.node_feat.shape[1]
+
+    @property
+    def max_edges(self) -> int:
+        return self.edge_index.shape[2]
+
+    @property
+    def n_node(self) -> jnp.ndarray:
+        """[B] float — true node count per graph (per partition when sharded)."""
+        return jnp.sum(self.node_mask, axis=1)
+
+    @property
+    def row(self) -> jnp.ndarray:
+        return self.edge_index[:, 0, :]
+
+    @property
+    def col(self) -> jnp.ndarray:
+        return self.edge_index[:, 1, :]
+
+
+def _round_up(x: int, multiple: int) -> int:
+    return ((x + multiple - 1) // multiple) * multiple
+
+
+def pad_graphs(
+    graphs: Sequence[dict],
+    max_nodes: Optional[int] = None,
+    max_edges: Optional[int] = None,
+    node_bucket: int = 8,
+    edge_bucket: int = 128,
+    dtype=np.float32,
+) -> "GraphBatch":
+    """Pack a list of per-graph numpy dicts into one padded GraphBatch.
+
+    Each dict has keys: node_feat [n,F], loc/vel/target [n,3], edge_index [2,e],
+    edge_attr [e,D], optional node_attr [n,A], optional loc_mean [3].
+    Bucketing rounds N/E up so nearby sizes share one compiled program.
+
+    loc_mean contract: when a dict omits loc_mean, it falls back to the mean of
+    the dict's OWN positions — correct only for whole (unpartitioned) graphs.
+    Partition pipelines MUST pass the global mean explicitly (the partitioners
+    in distegnn_tpu.data do), since GraphBatch.loc_mean seeds the replicated
+    virtual-node coordinates across devices.
+    """
+    bsz = len(graphs)
+    n_max = max(g["loc"].shape[0] for g in graphs)
+    e_max = max(g["edge_index"].shape[1] for g in graphs)
+    N = max_nodes if max_nodes is not None else _round_up(max(n_max, 1), node_bucket)
+    E = max_edges if max_edges is not None else _round_up(max(e_max, 1), edge_bucket)
+    if N < n_max or E < e_max:
+        raise ValueError(f"pad_graphs: max_nodes/max_edges ({N},{E}) < actual ({n_max},{e_max})")
+
+    F = graphs[0]["node_feat"].shape[1]
+    A = graphs[0].get("node_attr", np.zeros((0, 0))).shape[1] if graphs[0].get("node_attr") is not None else 0
+    D = graphs[0]["edge_attr"].shape[1] if graphs[0].get("edge_attr") is not None else 0
+
+    node_feat = np.zeros((bsz, N, F), dtype)
+    node_attr = np.zeros((bsz, N, A), dtype)
+    loc = np.zeros((bsz, N, 3), dtype)
+    vel = np.zeros((bsz, N, 3), dtype)
+    target = np.zeros((bsz, N, 3), dtype)
+    loc_mean = np.zeros((bsz, 3), dtype)
+    node_mask = np.zeros((bsz, N), dtype)
+    edge_index = np.zeros((bsz, 2, E), np.int32)
+    edge_attr = np.zeros((bsz, E, D), dtype)
+    edge_mask = np.zeros((bsz, E), dtype)
+
+    for b, g in enumerate(graphs):
+        n = g["loc"].shape[0]
+        e = g["edge_index"].shape[1]
+        node_feat[b, :n] = g["node_feat"]
+        if A:
+            node_attr[b, :n] = g["node_attr"]
+        loc[b, :n] = g["loc"]
+        vel[b, :n] = g["vel"]
+        if g.get("target") is not None:
+            target[b, :n] = g["target"]
+        loc_mean[b] = g["loc_mean"] if g.get("loc_mean") is not None else g["loc"].mean(axis=0)
+        node_mask[b, :n] = 1.0
+        edge_index[b, :, :e] = g["edge_index"]
+        if D and g.get("edge_attr") is not None:
+            edge_attr[b, :e] = g["edge_attr"]
+        edge_mask[b, :e] = 1.0
+
+    return GraphBatch(
+        node_feat=node_feat, node_attr=node_attr, loc=loc, vel=vel, target=target,
+        loc_mean=loc_mean, node_mask=node_mask, edge_index=edge_index,
+        edge_attr=edge_attr, edge_mask=edge_mask,
+    )
+
+
+def batch_graphs(graphs: Sequence[dict], **kw) -> "GraphBatch":
+    """Alias of pad_graphs (name mirrors a DataLoader collate step)."""
+    return pad_graphs(graphs, **kw)

@@ -1,0 +1,6 @@
+from distegnn_tpu.parallel.collectives import (  # noqa: F401
+    pweighted_mean,
+    global_node_mean,
+    global_node_sum,
+)
+from distegnn_tpu.parallel.mesh import make_mesh, GRAPH_AXIS, DATA_AXIS  # noqa: F401

@@ -1,0 +1,41 @@
+"""Device mesh construction — the TPU replacement for the reference's
+process-group world (reference main.py:143,159-163 hard-wires single-node NCCL
+with rank = LOCAL_RANK; SURVEY.md §2.10 flags multi-host as a gap to fill).
+
+Axes:
+  GRAPH_AXIS ('graph') — spatial graph partitions; carries the per-layer
+      virtual-node psums (the only cross-partition traffic, constant-size).
+      Lay this axis over ICI: it communicates every layer.
+  DATA_AXIS  ('data')  — batch data parallelism; gradient psum once per step.
+      May span DCN on multi-host pods.
+
+Multi-host: `jax.distributed.initialize()` + the same code — shard_map over a
+global mesh handles cross-host collectives; there is no rank-conditional code
+anywhere in the framework (rank-0-style work like checkpoint writes keys off
+``jax.process_index() == 0``).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import numpy as np
+import jax
+from jax.sharding import Mesh
+
+GRAPH_AXIS = "graph"
+DATA_AXIS = "data"
+
+
+def make_mesh(n_graph: int = 1, n_data: int = 1, devices: Optional[Sequence] = None) -> Mesh:
+    """Build a (graph, data) mesh over the available devices.
+
+    n_graph * n_data must equal the device count used. The graph axis is placed
+    minor (fastest-varying) so partitions of one graph land on ICI-adjacent
+    chips and the per-layer psums stay off DCN.
+    """
+    devices = list(devices if devices is not None else jax.devices())
+    if n_graph * n_data != len(devices):
+        raise ValueError(f"mesh {n_graph}x{n_data} != {len(devices)} devices")
+    arr = np.asarray(devices).reshape(n_data, n_graph)
+    return Mesh(arr, (DATA_AXIS, GRAPH_AXIS))

@@ -1,0 +1,2 @@
+from distegnn_tpu.utils.seed import fix_seed  # noqa: F401
+from distegnn_tpu.utils import rotate  # noqa: F401

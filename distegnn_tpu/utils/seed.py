@@ -1,0 +1,21 @@
+"""Determinism. The reference seeds python/numpy/torch + cudnn-deterministic
+(reference utils/seed.py:6-14). XLA is deterministic by default; JAX randomness
+is explicit via PRNG keys, which also solves the reference's cross-rank RNG
+discipline problem (SURVEY.md §7.4.7) — every host derives identical keys from
+the config seed, so samplers agree by construction instead of by side effect.
+"""
+
+from __future__ import annotations
+
+import random
+
+import numpy as np
+import jax
+
+
+def fix_seed(seed: int = 43) -> jax.Array:
+    """Seed host-side RNGs (python, numpy — used by data pipeline) and return
+    the root JAX PRNG key for everything traced."""
+    random.seed(seed)
+    np.random.seed(seed)
+    return jax.random.PRNGKey(seed)

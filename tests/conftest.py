@@ -1,0 +1,26 @@
+"""Test harness: force an 8-virtual-device CPU platform so multi-chip sharding
+is exercised without a pod (SURVEY.md §4: simulate the 8-way partition on CPU).
+
+Note: a pytest plugin imports jax before this conftest runs, so env vars are
+too late — use jax.config.update instead (valid until a backend initializes).
+float32 matmuls run at 'highest' precision so equivariance tolerances (1e-4,
+parity with reference equivariant_test.py:62) hold on any backend.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_num_cpu_devices", 8)
+jax.config.update("jax_default_matmul_precision", "highest")
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture
+def rng():
+    return np.random.default_rng(43)

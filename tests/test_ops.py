@@ -1,0 +1,94 @@
+"""Numerical parity tests for the ops library against small dense references
+(SURVEY.md §4 implication (2))."""
+
+import numpy as np
+import jax.numpy as jnp
+
+from distegnn_tpu.ops import (
+    segment_sum, segment_mean, masked_mean,
+    radius_graph_np, full_graph_np, cutoff_edges_np, pad_graphs,
+)
+
+
+def test_segment_sum_matches_dense(rng):
+    data = rng.normal(size=(20, 4)).astype(np.float32)
+    ids = rng.integers(0, 5, size=20)
+    out = segment_sum(jnp.asarray(data), jnp.asarray(ids), 5)
+    expect = np.zeros((5, 4), np.float32)
+    for i, s in enumerate(ids):
+        expect[s] += data[i]
+    np.testing.assert_allclose(np.asarray(out), expect, rtol=1e-5)
+
+
+def test_segment_mean_empty_segment_is_zero(rng):
+    data = rng.normal(size=(6, 3)).astype(np.float32)
+    ids = np.array([0, 0, 1, 1, 1, 3])  # segment 2 empty
+    out = np.asarray(segment_mean(jnp.asarray(data), jnp.asarray(ids), 4))
+    np.testing.assert_allclose(out[0], data[:2].mean(0), rtol=1e-5)
+    np.testing.assert_allclose(out[1], data[2:5].mean(0), rtol=1e-5)
+    np.testing.assert_allclose(out[2], 0.0)
+    np.testing.assert_allclose(out[3], data[5], rtol=1e-5)
+
+
+def test_segment_mean_respects_mask(rng):
+    data = rng.normal(size=(8, 2)).astype(np.float32)
+    ids = np.array([0, 0, 0, 1, 1, 0, 0, 0])
+    mask = np.array([1, 1, 1, 1, 1, 0, 0, 0], np.float32)  # last 3 are padding
+    out = np.asarray(segment_mean(jnp.asarray(data), jnp.asarray(ids), 2, mask=jnp.asarray(mask)))
+    np.testing.assert_allclose(out[0], data[:3].mean(0), rtol=1e-5)
+    np.testing.assert_allclose(out[1], data[3:5].mean(0), rtol=1e-5)
+
+
+def test_masked_mean(rng):
+    data = rng.normal(size=(2, 5, 3)).astype(np.float32)
+    mask = np.array([[1, 1, 1, 0, 0], [1, 1, 1, 1, 1]], np.float32)
+    out = np.asarray(masked_mean(jnp.asarray(data), jnp.asarray(mask), axis=1))
+    np.testing.assert_allclose(out[0], data[0, :3].mean(0), rtol=1e-5)
+    np.testing.assert_allclose(out[1], data[1].mean(0), rtol=1e-5)
+
+
+def test_full_graph_count():
+    ei = full_graph_np(100)
+    assert ei.shape == (2, 9900)  # reference n-body: N=100 -> E=9900
+    assert not np.any(ei[0] == ei[1])
+
+
+def test_radius_graph_matches_bruteforce(rng):
+    pos = rng.uniform(0, 1, size=(60, 3))
+    r = 0.3
+    ei = radius_graph_np(pos, r)
+    # brute force
+    d = np.linalg.norm(pos[:, None] - pos[None, :], axis=-1)
+    expect = np.argwhere((d < r) & ~np.eye(60, dtype=bool))
+    got = set(map(tuple, ei.T.tolist()))
+    assert got == set(map(tuple, expect.tolist()))
+
+
+def test_cutoff_edges(rng):
+    pos = rng.uniform(0, 1, size=(30, 3))
+    ei = radius_graph_np(pos, 0.5)
+    out = cutoff_edges_np(ei, pos, 0.4)
+    assert out.shape[1] == int(round(ei.shape[1] * 0.6))
+    d_all = np.linalg.norm(pos[ei[0]] - pos[ei[1]], axis=1)
+    d_kept = np.linalg.norm(pos[out[0]] - pos[out[1]], axis=1)
+    assert d_kept.max() <= np.sort(d_all)[out.shape[1] - 1] + 1e-12
+
+
+def test_pad_graphs_shapes(rng):
+    graphs = []
+    for n, e in [(5, 12), (7, 20)]:
+        graphs.append(dict(
+            node_feat=rng.normal(size=(n, 2)).astype(np.float32),
+            loc=rng.normal(size=(n, 3)).astype(np.float32),
+            vel=rng.normal(size=(n, 3)).astype(np.float32),
+            target=rng.normal(size=(n, 3)).astype(np.float32),
+            edge_index=rng.integers(0, n, size=(2, e)),
+            edge_attr=rng.normal(size=(e, 1)).astype(np.float32),
+        ))
+    gb = pad_graphs(graphs, node_bucket=8, edge_bucket=16)
+    assert gb.node_feat.shape == (2, 8, 2)
+    assert gb.edge_index.shape == (2, 2, 32)
+    np.testing.assert_allclose(np.asarray(gb.n_node), [5, 7])
+    np.testing.assert_allclose(np.asarray(gb.loc_mean[0]), graphs[0]["loc"].mean(0), rtol=1e-5)
+    # padded edges masked out
+    assert np.asarray(gb.edge_mask).sum() == 32

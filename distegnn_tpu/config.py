@@ -1,0 +1,224 @@
+"""Config system — YAML schema + CLI overrides + derived fields.
+
+Mirrors the reference's config flow (main.py:96-157): a four-section YAML
+(model/data/train/log + seed), an argparse layer that overrides 11 chosen
+fields one-by-one, and derived fields injected at load time (world_size,
+exp_name). The reference uses EasyDict with zero validation; here we add a
+defaults/validation layer (SURVEY.md §5.6 flags its absence as a gap) while
+keeping the exact same YAML schema so reference configs load unchanged.
+
+TPU deltas:
+  - ``train.device`` (a CUDA ordinal in the reference) is accepted but ignored;
+    device placement is the mesh's job (distegnn_tpu.parallel.mesh).
+  - ``data.world_size`` derives from ``len(jax.devices())`` (reference:
+    torch.cuda.device_count(), main.py:143) but may be overridden for
+    CPU-simulated meshes.
+"""
+
+from __future__ import annotations
+
+import argparse
+import copy
+import time
+from typing import Any, Mapping, Optional
+
+import yaml
+
+
+class ConfigDict(dict):
+    """dict with attribute access, recursively (the EasyDict role)."""
+
+    def __init__(self, data: Optional[Mapping] = None):
+        super().__init__()
+        for k, v in (data or {}).items():
+            self[k] = ConfigDict(v) if isinstance(v, Mapping) and not isinstance(v, ConfigDict) else v
+
+    def __getattr__(self, name: str) -> Any:
+        try:
+            return self[name]
+        except KeyError as e:
+            raise AttributeError(name) from e
+
+    def __setattr__(self, name: str, value: Any) -> None:
+        self[name] = ConfigDict(value) if isinstance(value, Mapping) and not isinstance(value, ConfigDict) else value
+
+    def __deepcopy__(self, memo):
+        return ConfigDict({k: copy.deepcopy(v, memo) for k, v in self.items()})
+
+    def to_dict(self) -> dict:
+        return {k: v.to_dict() if isinstance(v, ConfigDict) else v for k, v in self.items()}
+
+
+# Defaults merged under the YAML (YAML wins). Field set = union of the five
+# reference configs (config/*.yaml) — same names, same sections.
+_DEFAULTS: dict = {
+    "seed": 43,
+    "model": {
+        "model_name": "FastEGNN",
+        "normalize": False,
+        "hidden_nf": 64,
+        "n_layers": 4,
+        "virtual_channels": 3,
+        "node_feat_nf": 2,
+        "node_attr_nf": 0,
+        "edge_attr_nf": 2,
+        "checkpoint": None,
+    },
+    "data": {
+        "data_dir": "./data",
+        "dataset_name": "nbody_100",
+        "max_samples": 5000,
+        "batch_size": 1,
+        "accelerate_mode": "cutoff_edges",  # or 'distribute'
+        # cutoff_edges mode:
+        "radius": -1.0,
+        "cutoff_rate": 0.0,
+        # distribute mode:
+        "outer_radius": None,
+        "inner_radius": None,
+        "split_mode": "metis",
+        # per-dataset frame selection:
+        "frame_0": 30,
+        "frame_T": 40,
+        "delta_t": 20,
+        "backbone": True,
+        "test_rot": False,
+        "test_trans": False,
+        # padding buckets (TPU-only knobs; static-shape batching):
+        "node_bucket": 8,
+        "edge_bucket": 128,
+    },
+    "train": {
+        "learning_rate": 5e-4,
+        "weight_decay": 1e-12,
+        "epochs": 2500,
+        "early_stop": 2500,
+        "device": None,  # accepted for reference-config compat; unused on TPU
+        "mmd": {"sigma": 1.5, "weight": 0.03, "samples": 3},
+        "accumulation_steps": 1,
+        "warmup_epochs": 0,
+        "scheduler": "None",
+    },
+    "log": {
+        "log_dir": "./logs",
+        "test_interval": 2,
+        "wandb": {"enable": False, "offline": True, "api_key": "", "project": "", "entity": ""},
+    },
+}
+
+_VALID_SPLIT_MODES = ("random", "metis", "spectral", "kmeans")
+_VALID_ACCEL_MODES = ("cutoff_edges", "distribute")
+
+
+def _merge(base: dict, override: Mapping) -> dict:
+    out = copy.deepcopy(base)
+    for k, v in override.items():
+        if isinstance(v, Mapping) and isinstance(out.get(k), dict):
+            out[k] = _merge(out[k], v)
+        else:
+            out[k] = v
+    return out
+
+
+def load_config(path: str, overrides: Optional[Mapping] = None) -> ConfigDict:
+    """Load YAML, merge over defaults, apply overrides, validate, derive."""
+    with open(path) as f:
+        raw = yaml.safe_load(f) or {}
+    cfg = ConfigDict(_merge(_DEFAULTS, raw))
+    if overrides:
+        apply_overrides(cfg, overrides)
+    validate_config(cfg)
+    return cfg
+
+
+# CLI-overridable fields: name -> (section path, type). Parity with the
+# reference's argparse block (main.py:96-140) minus the torch device plumbing.
+_CLI_FIELDS = {
+    "lr": ("train.learning_rate", float),
+    "seed": ("seed", int),
+    "model_name": ("model.model_name", str),
+    "batch_size": ("data.batch_size", int),
+    "split_mode": ("data.split_mode", str),
+    "early_stop": ("train.early_stop", int),
+    "checkpoint": ("model.checkpoint", str),
+    "cutoff_rate": ("data.cutoff_rate", float),
+    "outer_radius": ("data.outer_radius", float),
+    "inner_radius": ("data.inner_radius", float),
+    "virtual_channels": ("model.virtual_channels", int),
+    "epochs": ("train.epochs", int),
+    "world_size": ("data.world_size", int),
+}
+
+
+def _set_path(cfg: ConfigDict, dotted: str, value: Any) -> None:
+    node = cfg
+    parts = dotted.split(".")
+    for p in parts[:-1]:
+        node = node[p]
+    node[parts[-1]] = value
+
+
+def apply_overrides(cfg: ConfigDict, overrides: Mapping) -> None:
+    """Apply {field: value} overrides; None values are skipped (reference
+    semantics: only explicitly-passed CLI flags override, main.py:117-140)."""
+    for name, value in overrides.items():
+        if value is None:
+            continue
+        if name == "wandb":
+            if value:
+                cfg.log.wandb.offline = False
+            continue
+        if name not in _CLI_FIELDS:
+            raise KeyError(f"unknown override {name!r}; valid: {sorted(_CLI_FIELDS)}")
+        _set_path(cfg, _CLI_FIELDS[name][0], value)
+
+
+def build_arg_parser() -> argparse.ArgumentParser:
+    parser = argparse.ArgumentParser(description="DistEGNN-TPU trainer")
+    parser.add_argument("--config_path", type=str, required=True)
+    parser.add_argument("--wandb", action="store_true")
+    for name, (_, typ) in _CLI_FIELDS.items():
+        parser.add_argument(f"--{name}", type=typ, default=None)
+    return parser
+
+
+def validate_config(cfg: ConfigDict) -> None:
+    if cfg.data.accelerate_mode not in _VALID_ACCEL_MODES:
+        raise ValueError(f"data.accelerate_mode must be one of {_VALID_ACCEL_MODES}")
+    if cfg.data.accelerate_mode == "distribute":
+        if cfg.data.split_mode not in _VALID_SPLIT_MODES:
+            raise ValueError(f"data.split_mode must be one of {_VALID_SPLIT_MODES}")
+        if cfg.data.outer_radius is None or cfg.data.inner_radius is None:
+            raise ValueError("distribute mode requires data.outer_radius and data.inner_radius")
+    if not 0.0 <= float(cfg.data.cutoff_rate) < 1.0:
+        raise ValueError("data.cutoff_rate must be in [0, 1)")
+    if cfg.train.accumulation_steps < 1:
+        raise ValueError("train.accumulation_steps must be >= 1")
+    if cfg.model.virtual_channels < 1:
+        raise ValueError("model.virtual_channels must be >= 1")
+
+
+def derive_runtime_fields(cfg: ConfigDict, world_size: Optional[int] = None) -> ConfigDict:
+    """Inject data.world_size and log.exp_name (reference main.py:143-157).
+
+    exp_name encodes dataset/split/model/radii/world_size/channels/timestamp —
+    the same recipe, so runs are identifiable the same way.
+    """
+    if world_size is None:
+        world_size = cfg.data.get("world_size")
+    if world_size is None:
+        import jax
+        world_size = len(jax.devices())
+    cfg.data.world_size = int(world_size)
+
+    d = cfg.data
+    if d.accelerate_mode == "distribute":
+        geo = f"{d.split_mode}_o{d.outer_radius}_i{d.inner_radius}"
+    else:
+        geo = f"r{d.radius}_cut{d.cutoff_rate}"
+    stamp = time.strftime("%Y%m%d_%H%M%S")
+    cfg.log.exp_name = (
+        f"{d.dataset_name}_{geo}_{cfg.model.model_name}"
+        f"_ws{cfg.data.world_size}_C{cfg.model.virtual_channels}_{stamp}"
+    )
+    return cfg

@@ -83,9 +83,12 @@ class CoordMLP(nn.Module):
 
     @nn.compact
     def __call__(self, x):
-        x = TorchDense(self.hidden_nf)(x)
-        x = self.act(x)
-        x = nn.Dense(1, use_bias=False, kernel_init=coord_head_init)(x)
+        x = MLP(
+            [self.hidden_nf, 1],
+            act=self.act,
+            use_bias_last=False,
+            kernel_init_last=coord_head_init,
+        )(x)
         if self.tanh:
             x = jnp.tanh(x)
         return x

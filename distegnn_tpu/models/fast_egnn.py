@@ -113,6 +113,8 @@ class EGCLVel(nn.Module):
         vef = vef * node_mask[:, :, None, None]                          # zero padded nodes
 
         # --- real coordinate update (coord_model_vel, :166-188)
+        if self.coords_agg not in ("sum", "mean"):
+            raise ValueError(f"Wrong coords_agg parameter {self.coords_agg!r}")
         trans = coord_diff * CoordMLP(H, tanh=self.tanh, name="phi_x")(edge_feat)  # [B, E, 3]
         seg = segment_sum if self.coords_agg == "sum" else segment_mean
         agg = jax.vmap(lambda t, r, m: seg(t, r, N, mask=m))(trans, row, edge_mask)  # [B, N, 3]

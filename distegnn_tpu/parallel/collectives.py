@@ -45,8 +45,9 @@ def pweighted_mean(data: jnp.ndarray, weight: jnp.ndarray, axis_name: Optional[s
 def global_node_sum(data: jnp.ndarray, mask: jnp.ndarray, axis_name: Optional[str] = None):
     """Masked sum over the node axis (axis=1 of [B, N, ...]), then summed across
     mesh partitions. Returns ([B, ...] sum, [B] count)."""
-    m = mask.astype(data.dtype).reshape(mask.shape + (1,) * (data.ndim - mask.ndim))
-    s = _psum(jnp.sum(data * m, axis=1), axis_name)
+    from distegnn_tpu.ops.segment import masked_sum
+
+    s = _psum(masked_sum(data, mask, axis=1), axis_name)
     c = _psum(jnp.sum(mask.astype(data.dtype), axis=1), axis_name)
     return s, c
 

@@ -1,0 +1,83 @@
+"""Config system tests — schema load, defaults, overrides, validation, derived
+fields (reference behavior: main.py:96-157)."""
+
+import pytest
+
+from distegnn_tpu.config import (
+    ConfigDict,
+    apply_overrides,
+    build_arg_parser,
+    derive_runtime_fields,
+    load_config,
+)
+
+CFG = "configs/nbody_fastegnn.yaml"
+
+
+def test_load_reference_schema():
+    cfg = load_config(CFG)
+    assert cfg.model.model_name == "FastEGNN"
+    assert cfg.model.hidden_nf == 64
+    assert cfg.data.dataset_name == "nbody_100"
+    assert cfg.data.batch_size == 250
+    assert cfg.train.mmd.sigma == 1.5
+    assert cfg.seed == 43
+    # defaults fill fields the YAML omits
+    assert cfg.data.split_mode == "metis"
+    assert cfg.model.checkpoint is None
+
+
+def test_cli_overrides_none_skipped():
+    cfg = load_config(CFG, overrides={"lr": 1e-3, "seed": None, "virtual_channels": 5})
+    assert cfg.train.learning_rate == 1e-3
+    assert cfg.seed == 43  # None → untouched (reference main.py:119-120)
+    assert cfg.model.virtual_channels == 5
+
+
+def test_unknown_override_rejected():
+    cfg = load_config(CFG)
+    with pytest.raises(KeyError):
+        apply_overrides(cfg, {"not_a_field": 1})
+
+
+def test_validation_distribute_requires_radii():
+    cfg = load_config(CFG)
+    cfg.data.accelerate_mode = "distribute"
+    cfg.data.outer_radius = None
+    from distegnn_tpu.config import validate_config
+
+    with pytest.raises(ValueError):
+        validate_config(cfg)
+
+
+def test_distribute_config_loads():
+    cfg = load_config("configs/largefluid_distegnn.yaml")
+    assert cfg.data.accelerate_mode == "distribute"
+    assert cfg.data.outer_radius == 0.075
+    assert cfg.train.accumulation_steps == 4
+    assert cfg.train.mmd.samples == 50
+
+
+def test_derived_fields():
+    cfg = load_config(CFG)
+    derive_runtime_fields(cfg, world_size=4)
+    assert cfg.data.world_size == 4
+    assert "nbody_100" in cfg.log.exp_name
+    assert "ws4" in cfg.log.exp_name
+    assert "C3" in cfg.log.exp_name
+
+
+def test_arg_parser_roundtrip():
+    parser = build_arg_parser()
+    args = parser.parse_args(["--config_path", CFG, "--lr", "0.001", "--batch_size", "8"])
+    cfg = load_config(args.config_path, overrides={k: v for k, v in vars(args).items() if k != "config_path"})
+    assert cfg.train.learning_rate == 0.001
+    assert cfg.data.batch_size == 8
+
+
+def test_configdict_attribute_access():
+    c = ConfigDict({"a": {"b": 1}})
+    assert c.a.b == 1
+    c.a.b = 2
+    assert c["a"]["b"] == 2
+    assert c.to_dict() == {"a": {"b": 2}}

@@ -68,7 +68,8 @@ def test_cutoff_edges(rng):
     pos = rng.uniform(0, 1, size=(30, 3))
     ei = radius_graph_np(pos, 0.5)
     out = cutoff_edges_np(ei, pos, 0.4)
-    assert out.shape[1] == int(round(ei.shape[1] * 0.6))
+    # same truncation formula as the implementation (reference `int(E * (1-rate))`)
+    assert out.shape[1] == int(ei.shape[1] * (1.0 - 0.4))
     d_all = np.linalg.norm(pos[ei[0]] - pos[ei[1]], axis=1)
     d_kept = np.linalg.norm(pos[out[0]] - pos[out[1]], axis=1)
     assert d_kept.max() <= np.sort(d_all)[out.shape[1] - 1] + 1e-12

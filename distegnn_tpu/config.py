@@ -113,6 +113,8 @@ _VALID_ACCEL_MODES = ("cutoff_edges", "distribute")
 def _merge(base: dict, override: Mapping) -> dict:
     out = copy.deepcopy(base)
     for k, v in override.items():
+        if v is None and isinstance(out.get(k), dict):
+            continue  # bare `section:` header in YAML — keep the defaults
         if isinstance(v, Mapping) and isinstance(out.get(k), dict):
             out[k] = _merge(out[k], v)
         else:
@@ -166,6 +168,10 @@ def apply_overrides(cfg: ConfigDict, overrides: Mapping) -> None:
             continue
         if name == "wandb":
             if value:
+                # explicit --wandb means "log online": enable AND go online
+                # (reference configs ship enable=True so its flag only flips
+                # offline, main.py:118; ours ship enable=False by default)
+                cfg.log.wandb.enable = True
                 cfg.log.wandb.offline = False
             continue
         if name not in _CLI_FIELDS:

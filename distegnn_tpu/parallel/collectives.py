@@ -25,6 +25,8 @@ from typing import Optional
 import jax
 import jax.numpy as jnp
 
+from distegnn_tpu.ops.segment import masked_sum
+
 
 def _psum(x, axis_name):
     return jax.lax.psum(x, axis_name) if axis_name is not None else x
@@ -45,8 +47,6 @@ def pweighted_mean(data: jnp.ndarray, weight: jnp.ndarray, axis_name: Optional[s
 def global_node_sum(data: jnp.ndarray, mask: jnp.ndarray, axis_name: Optional[str] = None):
     """Masked sum over the node axis (axis=1 of [B, N, ...]), then summed across
     mesh partitions. Returns ([B, ...] sum, [B] count)."""
-    from distegnn_tpu.ops.segment import masked_sum
-
     s = _psum(masked_sum(data, mask, axis=1), axis_name)
     c = _psum(jnp.sum(mask.astype(data.dtype), axis=1), axis_name)
     return s, c

@@ -1,0 +1,65 @@
+"""Checkpoint save/restore (reference utils/train.py:234-259, main.py:208-220).
+
+Saves {epoch, params, opt_state, losses, config} — the same payload as the
+reference's best_model.pth/last_model.pth. Written by process 0 only
+(``jax.process_index() == 0``; params are replicated so any host's copy is the
+global state — reference does the same with rank 0, SURVEY.md §5.4).
+
+Format: pickle of numpy leaf lists + the pytree re-built from a template at
+restore time (so saved files don't depend on optax's internal tree classes
+being pickleable across versions). Unlike the reference (whose DDP-wrapped
+state_dicts are not portable between world sizes, SURVEY.md §5.4), params here
+carry no wrapper prefix — checkpoints are world-size-portable by construction.
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+from typing import Any, Optional
+
+import jax
+import numpy as np
+
+
+def _to_leaves(tree) -> list:
+    return [np.asarray(x) for x in jax.tree.leaves(tree)]
+
+
+def _from_leaves(template, leaves: list):
+    treedef = jax.tree.structure(template)
+    return jax.tree.unflatten(treedef, [np.asarray(l) for l in leaves])
+
+
+def save_checkpoint(path: str, state, epoch: int, losses: Optional[dict] = None,
+                    config: Optional[dict] = None) -> None:
+    if jax.process_index() != 0:
+        return
+    payload = {
+        "epoch": int(epoch),
+        "params_leaves": _to_leaves(state.params),
+        "opt_state_leaves": _to_leaves(state.opt_state),
+        "step": int(state.step),
+        "losses": losses or {},
+        "config": config,
+    }
+    tmp = path + ".tmp"
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    with open(tmp, "wb") as f:
+        pickle.dump(payload, f)
+    os.replace(tmp, path)  # atomic: a crash never leaves a torn checkpoint
+
+
+def restore_checkpoint(path: str, state) -> tuple[Any, int, dict]:
+    """Restore into the structure of ``state`` (a freshly-created TrainState).
+    Returns (state, start_epoch, losses)."""
+    with open(path, "rb") as f:
+        payload = pickle.load(f)
+    from distegnn_tpu.train.step import TrainState
+
+    restored = TrainState(
+        params=_from_leaves(state.params, payload["params_leaves"]),
+        opt_state=_from_leaves(state.opt_state, payload["opt_state_leaves"]),
+        step=np.int32(payload["step"]),
+    )
+    return restored, payload["epoch"], payload.get("losses", {})

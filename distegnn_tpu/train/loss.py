@@ -1,0 +1,90 @@
+"""Loss functions: node-weighted global MSE + MMD virtual-node regularizer.
+
+Reference semantics (utils/train.py:98-147):
+  - per-device MSE over its partition's nodes, scaled by node_cnt/total_node_cnt
+    (allreduce SUM of counts), summed across devices — so gradients SUM over
+    partitions (the reference multiplies by world_size to undo DDP's mean;
+    here the psum expresses the sum directly).
+  - MMD: RBF kernel exp(-d/(2 sigma^2)) on *Euclidean* distances between the C
+    virtual-node locations and samples*C randomly-drawn target positions per
+    graph; loss_mmd = l_vv - l_rv with the reference's exact normalizations
+    (utils/train.py:119-147).
+
+TPU deltas: the reference's per-graph Python loop with torch.randperm becomes
+a vmapped Gumbel top-k sample over the padded node axis (SURVEY.md §7.4 item
+4) — fully traced, no host sync.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from distegnn_tpu.ops.graph import GraphBatch
+from distegnn_tpu.parallel.collectives import _psum
+
+
+def masked_mse(pred: jnp.ndarray, target: jnp.ndarray, node_mask: jnp.ndarray) -> jnp.ndarray:
+    """MSE over real nodes of the whole batch — nn.MSELoss on the flat node
+    axis (mean over nodes*3), restricted to mask==1 rows."""
+    err = (pred - target) ** 2 * node_mask[..., None]
+    cnt = jnp.maximum(jnp.sum(node_mask), 1.0)
+    return jnp.sum(err) / (cnt * pred.shape[-1])
+
+
+def rbf_kernel_sum(x: jnp.ndarray, y: jnp.ndarray, sigma: float,
+                   wx: Optional[jnp.ndarray] = None) -> jnp.ndarray:
+    """sum_ij w_i * exp(-||x_i - y_j|| / (2 sigma^2)). Euclidean distance, NOT
+    squared — parity with torch.cdist in reference kernel() (utils/train.py:11-14)."""
+    d2 = jnp.sum((x[:, None, :] - y[None, :, :]) ** 2, axis=-1)
+    d = jnp.sqrt(jnp.maximum(d2, 1e-24))
+    k = jnp.exp(-d / (2.0 * sigma * sigma))
+    if wx is not None:
+        k = k * wx[:, None]
+    return jnp.sum(k)
+
+
+def mmd_loss(
+    virtual_loc: jnp.ndarray,   # [B, 3, C]
+    target: jnp.ndarray,        # [B, N, 3]
+    node_mask: jnp.ndarray,     # [B, N]
+    key: jax.Array,
+    sigma: float,
+    samples: int,
+) -> jnp.ndarray:
+    """loss_mmd = l_vv - l_rv (reference normalizations, utils/train.py:141-145)."""
+    B, _, C = virtual_loc.shape
+    num_sample = samples * C
+    V = jnp.swapaxes(virtual_loc, 1, 2)  # [B, C, 3]
+
+    def per_graph(key_b, target_b, mask_b, V_b):
+        # Gumbel top-k == uniform sampling without replacement over real nodes
+        g = jax.random.gumbel(key_b, (target_b.shape[0],))
+        scores = g + jnp.log(jnp.maximum(mask_b, 1e-30))
+        _, idx = jax.lax.top_k(scores, num_sample)
+        sampled = target_b[idx]                      # [num_sample, 3]
+        valid = mask_b[idx]                          # 0 for padding (graph smaller than num_sample)
+        k_vv = rbf_kernel_sum(V_b, V_b, sigma)
+        k_rv = rbf_kernel_sum(sampled, V_b, sigma, wx=valid)
+        return k_vv, k_rv
+
+    keys = jax.random.split(key, B)
+    k_vv, k_rv = jax.vmap(per_graph)(keys, target, node_mask, V)
+    l_vv = jnp.sum(k_vv) / B / C / C
+    l_rv = 2.0 * jnp.sum(k_rv) / B / num_sample / C
+    return l_vv - l_rv
+
+
+def weighted_global_loss(
+    local_loss: jnp.ndarray,
+    node_mask: jnp.ndarray,
+    axis_name: Optional[str] = None,
+) -> jnp.ndarray:
+    """Scale a per-partition loss by node_cnt/total_node_cnt and SUM across
+    partitions (reference utils/train.py:100-110 + the world_size rescale).
+    Single-device this is the identity."""
+    node_cnt = jnp.sum(node_mask)
+    total = _psum(node_cnt, axis_name)
+    return _psum(local_loss * node_cnt / jnp.maximum(total, 1.0), axis_name)

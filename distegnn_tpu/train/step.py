@@ -1,0 +1,122 @@
+"""The jitted train/eval step — forward, loss (MSE + MMD), backward, clip,
+optimizer, all in ONE traced program (SURVEY.md §7.1 item 2: the reference's
+per-step Python work must become traced ops or disappear).
+
+Distributed: the same step function runs under ``shard_map`` with
+``axis_name='graph'`` — the model's virtual-node psums and the loss's
+node-count psum handle cross-partition exactness; parameter gradients come out
+identical on every device because the global loss already sums over the axis
+(reference achieves the same with DDP allreduce + a world_size rescale,
+main.py:196 + utils/train.py:110).
+
+Optimizer parity (reference main.py:197-202 + utils/train.py:150-158):
+torch.Adam with L2 weight_decay folded into the gradient, optional
+grad-clip-by-global-norm(0.3), loss/accumulation_steps with a step every k
+micro-batches (optax.MultiSteps), optional cosine schedule over
+epochs*len(loader)/accumulation_steps.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+import jax
+import jax.numpy as jnp
+import optax
+from flax import struct
+
+from distegnn_tpu.ops.graph import GraphBatch
+from distegnn_tpu.train.loss import masked_mse, mmd_loss, weighted_global_loss
+
+
+@struct.dataclass
+class TrainState:
+    params: dict
+    opt_state: optax.OptState
+    step: jnp.ndarray  # micro-batch counter
+
+    @classmethod
+    def create(cls, params, tx: optax.GradientTransformation) -> "TrainState":
+        return cls(params=params, opt_state=tx.init(params), step=jnp.zeros((), jnp.int32))
+
+
+def make_optimizer(
+    learning_rate: float,
+    weight_decay: float = 0.0,
+    clip_norm: Optional[float] = None,
+    accumulation_steps: int = 1,
+    total_steps: Optional[int] = None,
+    scheduler: str = "None",
+) -> optax.GradientTransformation:
+    """torch-Adam-parity chain: [clip] -> +wd*p -> adam moments -> -lr [cosine]."""
+    parts = []
+    if clip_norm is not None:
+        parts.append(optax.clip_by_global_norm(clip_norm))
+    if weight_decay:
+        # torch.Adam weight_decay: grad += wd * param BEFORE the moment update
+        parts.append(optax.add_decayed_weights(weight_decay))
+    parts.append(optax.scale_by_adam(b1=0.9, b2=0.999, eps=1e-8))
+    if scheduler == "cosine":
+        assert total_steps is not None, "cosine scheduler needs total_steps"
+        lr = optax.cosine_decay_schedule(learning_rate, total_steps)
+    else:
+        lr = learning_rate
+    parts.append(optax.scale_by_learning_rate(lr))
+    tx = optax.chain(*parts)
+    if accumulation_steps > 1:
+        # MultiSteps averages micro-grads — same math as the reference's
+        # loss/accumulation_steps + step-every-k (utils/train.py:150-158)
+        tx = optax.MultiSteps(tx, every_k_schedule=accumulation_steps)
+    return tx
+
+
+def make_loss_fn(model, mmd_weight: float, mmd_sigma: float, mmd_samples: int,
+                 axis_name: Optional[str] = None) -> Callable:
+    """loss(params, batch, key) -> (loss_for_grad, logged_mse).
+
+    loss_for_grad sums over partitions (exact global gradient); logged_mse is
+    the node-weighted global MSE the reference logs (total_loss_loc)."""
+
+    def loss_fn(params, batch: GraphBatch, key):
+        loc_pred, virtual_loc = model.apply(params, batch)
+        mse_local = masked_mse(loc_pred, batch.target, batch.node_mask)
+        loss = weighted_global_loss(mse_local, batch.node_mask, axis_name)
+        logged = loss
+        if mmd_weight:
+            if axis_name is not None:
+                # independent sample draw per partition (each rank samples its
+                # own local nodes, reference utils/train.py:124-139)
+                key = jax.random.fold_in(key, jax.lax.axis_index(axis_name))
+            lm = mmd_loss(virtual_loc, batch.target, batch.node_mask, key, mmd_sigma, mmd_samples)
+            loss = loss + mmd_weight * weighted_global_loss(lm, batch.node_mask, axis_name)
+        return loss, logged
+
+    return loss_fn
+
+
+def make_train_step(model, tx: optax.GradientTransformation, mmd_weight: float,
+                    mmd_sigma: float, mmd_samples: int,
+                    axis_name: Optional[str] = None) -> Callable:
+    """Returns step(state, batch, key) -> (state, metrics). Jit/shard_map it."""
+    loss_fn = make_loss_fn(model, mmd_weight, mmd_sigma, mmd_samples, axis_name)
+
+    def step(state: TrainState, batch: GraphBatch, key):
+        (loss, logged), grads = jax.value_and_grad(loss_fn, has_aux=True)(state.params, batch, key)
+        updates, opt_state = tx.update(grads, state.opt_state, state.params)
+        params = optax.apply_updates(state.params, updates)
+        new_state = TrainState(params=params, opt_state=opt_state, step=state.step + 1)
+        return new_state, {"loss": logged, "loss_with_mmd": loss}
+
+    return step
+
+
+def make_eval_step(model, axis_name: Optional[str] = None) -> Callable:
+    """Returns eval(params, batch) -> node-weighted global MSE (no MMD —
+    reference eval epochs compute only total_loss_loc)."""
+
+    def eval_step(params, batch: GraphBatch):
+        loc_pred, _ = model.apply(params, batch)
+        mse_local = masked_mse(loc_pred, batch.target, batch.node_mask)
+        return weighted_global_loss(mse_local, batch.node_mask, axis_name)
+
+    return eval_step

@@ -1,0 +1,161 @@
+"""Outer training loop (reference train(), utils/train.py:171-289).
+
+Epoch structure, best-model tracking on valid loss, early stopping, best/last
+checkpointing, per-epoch log.json, optional wandb, wall-clock time_cost — all
+preserved. Host-side logic keys off ``jax.process_index() == 0`` instead of
+rank 0; there is no early-stop allreduce because every host computes the same
+loop state deterministically (same losses via psum-inside-jit, same epochs) —
+the reference needs the MAX-allreduce only because its flag is set on rank 0
+alone (utils/train.py:261-267).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from typing import Callable, Optional
+
+import jax
+import numpy as np
+
+
+def run_epoch_train(train_step: Callable, state, loader, seed: int, epoch: int):
+    """One training epoch. Returns (state, avg loss) — the average of the
+    per-step node-weighted global MSE weighted by batch size (reference
+    result['loss']/result['counter'], utils/train.py:29,112-114)."""
+    loader.set_epoch(epoch)
+    total, counter = 0.0, 0.0
+    for step_idx, batch in enumerate(loader):
+        key = jax.random.PRNGKey(seed)
+        key = jax.random.fold_in(jax.random.fold_in(key, epoch), step_idx)
+        state, metrics = train_step(state, batch, key)
+        bsz = batch.loc.shape[-3] if batch.loc.ndim == 4 else batch.loc.shape[0]
+        total += float(metrics["loss"]) * bsz
+        counter += bsz
+    return state, total / max(counter, 1.0)
+
+
+def run_epoch_eval(eval_step: Callable, params, loader):
+    total, counter = 0.0, 0.0
+    for batch in loader:
+        loss = eval_step(params, batch)
+        bsz = batch.loc.shape[-3] if batch.loc.ndim == 4 else batch.loc.shape[0]
+        total += float(loss) * bsz
+        counter += bsz
+    return total / max(counter, 1.0)
+
+
+def train(
+    state,
+    train_step: Callable,
+    eval_step: Callable,
+    loader_train,
+    loader_valid,
+    loader_test,
+    config,
+    start_epoch: int = 0,
+    log: bool = True,
+):
+    """Full training run. Returns (state, best_log_dict, log_dict)."""
+    train_cfg, log_cfg = config.train, config.log
+    seed = config.seed
+    is_main = jax.process_index() == 0
+
+    log_dict = {"epochs": [], "loss": [], "loss_train": []}
+    best = {"epoch_index": 0, "loss_valid": 1e8, "loss_test": 1e8, "loss_train": 1e8}
+    best_state = state
+
+    exp_dir = os.path.join(log_cfg.log_dir, log_cfg.get("exp_name", "run"))
+    log_dir = os.path.join(exp_dir, "log")
+    ckpt_dir = os.path.join(exp_dir, "state_dict")
+    wandb_run = None
+    if is_main and log:
+        os.makedirs(log_dir, exist_ok=True)
+        os.makedirs(ckpt_dir, exist_ok=True)
+        if log_cfg.wandb.enable:
+            wandb_run = _init_wandb(config, exp_dir)
+    start = time.perf_counter()
+
+    for epoch in range(1 + start_epoch, train_cfg.epochs + 1):
+        state, loss_train = run_epoch_train(train_step, state, loader_train, seed, epoch)
+        log_dict["loss_train"].append(loss_train)
+
+        if epoch % log_cfg.test_interval == 0:
+            loss_valid = run_epoch_eval(eval_step, state.params, loader_valid)
+            loss_test = run_epoch_eval(eval_step, state.params, loader_test)
+            log_dict["epochs"].append(epoch)
+            log_dict["loss"].append(loss_test)
+
+            if loss_valid < best["loss_valid"]:
+                best = {"epoch_index": epoch, "loss_valid": loss_valid,
+                        "loss_test": loss_test, "loss_train": loss_train}
+                best_state = state
+                if is_main and log:
+                    _save(ckpt_dir, "best_model.ckpt", state, epoch, best, config)
+            if is_main and log:
+                _save(ckpt_dir, "last_model.ckpt", state, epoch,
+                      {"loss_train": loss_train, "loss_valid": loss_valid, "loss_test": loss_test},
+                      config)
+                if wandb_run is not None:
+                    wandb_run.log({"loss_train": loss_train, "loss_valid": loss_valid,
+                                   "loss_test": loss_test}, step=epoch)
+                print(f"*** Best Valid Loss: {best['loss_valid']:.5f} | "
+                      f"Best Test Loss: {best['loss_test']:.5f} | "
+                      f"Best Epoch Index: {best['epoch_index']}")
+
+            if epoch - best["epoch_index"] >= train_cfg.early_stop:
+                best["early_stop"] = epoch
+                if is_main:
+                    print(f"Early stopped! Epoch: {epoch}")
+                _write_log_json(log_dir, best, log_dict, config, start, is_main and log)
+                break
+        elif is_main and log and wandb_run is not None:
+            wandb_run.log({"loss_train": loss_train}, step=epoch)
+
+        _write_log_json(log_dir, best, log_dict, config, start, is_main and log)
+
+    if wandb_run is not None:
+        wandb_run.log({"best_test_loss": best["loss_test"]})
+        wandb_run.finish()
+    return state, best_state, best, log_dict
+
+
+def _save(ckpt_dir, name, state, epoch, losses, config):
+    from distegnn_tpu.train.checkpoint import save_checkpoint
+
+    cfg = config.to_dict() if hasattr(config, "to_dict") else dict(config)
+    save_checkpoint(os.path.join(ckpt_dir, name), state, epoch, losses=losses, config=cfg)
+
+
+def _write_log_json(log_dir, best, log_dict, config, start, enabled):
+    if not enabled:
+        return
+    best["time_cost"] = time.perf_counter() - start
+    cfg = config.to_dict() if hasattr(config, "to_dict") else dict(config)
+    with open(os.path.join(log_dir, "log.json"), "w") as f:
+        json.dump([best, log_dict, cfg], f, indent=4)
+
+
+def _init_wandb(config, exp_dir):
+    """wandb init (reference utils/train.py:185-198): offline-capable, env-var
+    API key, group = dataset name. Returns None if wandb isn't importable."""
+    try:
+        import wandb
+    except ImportError:
+        return None
+    log_cfg = config.log
+    if log_cfg.wandb.api_key:
+        os.environ["WANDB_API_KEY"] = log_cfg.wandb.api_key
+    if log_cfg.wandb.offline:
+        os.environ["WANDB_MODE"] = "offline"
+    cfg = config.to_dict() if hasattr(config, "to_dict") else dict(config)
+    return wandb.init(
+        config=cfg,
+        project=log_cfg.wandb.project or None,
+        entity=log_cfg.wandb.entity or None,
+        group=f"{config.data.dataset_name}",
+        name=log_cfg.exp_name,
+        dir=exp_dir,
+        reinit=True,
+    )

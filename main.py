@@ -1,0 +1,151 @@
+"""DistEGNN-TPU training entry point (parity with reference main.py).
+
+Usage:
+  python main.py --config_path configs/nbody_fastegnn.yaml [--lr ... --seed ...]
+
+Single program for single-chip and distributed runs: the reference launches one
+OS process per GPU via torchrun and wires NCCL (main.py:159-163); here a single
+process drives all local chips through one jitted step (shard_map over a
+`graph` mesh axis when accelerate_mode == 'distribute'), and multi-host pods
+need only `jax.distributed.initialize()` before the same code.
+"""
+
+from __future__ import annotations
+
+import os
+
+import jax
+import numpy as np
+
+from distegnn_tpu.config import build_arg_parser, derive_runtime_fields, load_config
+from distegnn_tpu.data import GraphDataset, GraphLoader, process_nbody_cutoff
+from distegnn_tpu.models.registry import get_model
+from distegnn_tpu.train import (
+    TrainState,
+    make_eval_step,
+    make_optimizer,
+    make_train_step,
+    restore_checkpoint,
+    train,
+)
+from distegnn_tpu.utils.seed import fix_seed
+
+
+def count_parameters(params) -> int:
+    return sum(int(np.prod(p.shape)) for p in jax.tree.leaves(params))
+
+
+def process_dataset_edge_cutoff(data_cfg):
+    """Dispatch by dataset (reference process_dataset_edge_cutoff,
+    datasets/process_dataset.py:32-45)."""
+    name = data_cfg.dataset_name
+    if name.startswith("nbody"):
+        return process_nbody_cutoff(
+            data_cfg.data_dir, name, data_cfg.max_samples, data_cfg.radius,
+            data_cfg.frame_0, data_cfg.frame_T, data_cfg.cutoff_rate,
+        )
+    if name == "protein":
+        try:
+            from distegnn_tpu.data.protein import process_protein_cutoff
+        except ImportError as e:
+            raise NotImplementedError("protein pipeline not built yet (SURVEY.md §7.2 stage 8)") from e
+
+        return process_protein_cutoff(
+            data_cfg.data_dir, name, data_cfg.max_samples, data_cfg.radius,
+            data_cfg.delta_t, data_cfg.cutoff_rate, backbone=data_cfg.backbone,
+            test_rot=data_cfg.test_rot, test_trans=data_cfg.test_trans,
+        )
+    if name == "Water-3D":
+        try:
+            from distegnn_tpu.data.water3d import process_water3d_cutoff
+        except ImportError as e:
+            raise NotImplementedError("Water-3D pipeline not built yet (SURVEY.md §7.2 stage 8)") from e
+
+        return process_water3d_cutoff(
+            data_cfg.data_dir, name, data_cfg.max_samples, data_cfg.radius,
+            data_cfg.delta_t, data_cfg.cutoff_rate,
+        )
+    raise NotImplementedError(f"{name} has no cutoff-mode processor")
+
+
+def needs_grad_clip(config) -> bool:
+    """Reference rule (utils/train.py:153-154): clip 0.3 only when distributed
+    or on the largest dataset, and only for FastEGNN."""
+    dist = config.data.world_size > 1
+    big = config.data.dataset_name in ("LargeFluid", "Fluid113K")
+    return (dist or big) and config.model.model_name == "FastEGNN"
+
+
+def main(argv=None):
+    parser = build_arg_parser()
+    args = parser.parse_args(argv)
+    overrides = {k: v for k, v in vars(args).items() if k != "config_path"}
+    config = load_config(args.config_path, overrides=overrides)
+
+    if config.data.accelerate_mode == "distribute":
+        try:
+            from distegnn_tpu.parallel.launch import run_distributed
+        except ImportError as e:
+            raise NotImplementedError("distribute mode not built yet (SURVEY.md §7.2 stage 6)") from e
+
+        return run_distributed(config)
+
+    # cutoff_edges mode is single-device by contract (reference main.py:173
+    # asserts world_size == 1); an explicit conflicting --world_size is an error
+    ws = config.data.get("world_size")
+    if ws not in (None, 1):
+        raise ValueError(f"accelerate_mode=cutoff_edges is single-device; got --world_size {ws}")
+    derive_runtime_fields(config, world_size=1)
+    fix_seed(config.seed)
+
+    # Data
+    files = process_dataset_edge_cutoff(config.data)
+    ds_train, ds_valid, ds_test = (GraphDataset(f) for f in files)
+    print(f"Data ready: {len(ds_train)}/{len(ds_valid)}/{len(ds_test)} graphs")
+    mk = lambda ds, shuffle: GraphLoader(
+        ds, config.data.batch_size, shuffle=shuffle, seed=config.seed,
+        node_bucket=config.data.node_bucket, edge_bucket=config.data.edge_bucket,
+    )
+    loader_train, loader_valid, loader_test = mk(ds_train, True), mk(ds_valid, False), mk(ds_test, False)
+
+    # Model
+    model = get_model(config.model, world_size=1, dataset_name=config.data.dataset_name)
+    sample = next(iter(loader_train))
+    params = model.init(jax.random.PRNGKey(config.seed), sample)
+    print(f"Model: {config.model.model_name}, {count_parameters(params)} parameters")
+
+    # Optimizer (+ reference clip rule and cosine schedule option)
+    total_steps = config.train.epochs * len(loader_train) // config.train.accumulation_steps
+    tx = make_optimizer(
+        config.train.learning_rate,
+        weight_decay=config.train.weight_decay,
+        clip_norm=0.3 if needs_grad_clip(config) else None,
+        accumulation_steps=config.train.accumulation_steps,
+        total_steps=total_steps,
+        scheduler=str(config.train.scheduler),
+    )
+    state = TrainState.create(params, tx)
+
+    start_epoch = 0
+    if config.model.checkpoint:
+        state, start_epoch, _ = restore_checkpoint(config.model.checkpoint, state)
+        print(f"Checkpoint loaded from {config.model.checkpoint} (epoch {start_epoch})")
+
+    # MMD applies to Fast* (virtual-node) models only (utils/train.py:119)
+    is_fast = config.model.model_name.startswith("Fast")
+    mmd_w = config.train.mmd.weight if is_fast else 0.0
+    train_step = jax.jit(make_train_step(model, tx, mmd_weight=mmd_w,
+                                         mmd_sigma=config.train.mmd.sigma,
+                                         mmd_samples=config.train.mmd.samples))
+    eval_step = jax.jit(make_eval_step(model))
+
+    state, best_state, best, log_dict = train(
+        state, train_step, eval_step, loader_train, loader_valid, loader_test,
+        config, start_epoch=start_epoch,
+    )
+    print(f"Done. Best: {best}")
+    return best
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,44 @@
+"""CLI n-body dataset generator (reference dataset_generation/nbody/
+generate_dataset.py). Writes reference-layout .npy files.
+
+Example (the paper's 100-ball charged config, reference run.sh):
+  python scripts/generate_nbody.py --path data/n_body_system/nbody_100 \
+      --n_isolated 100 --num-train 5000 --num-valid 2000 --num-test 2000 --seed 43
+"""
+
+from __future__ import annotations
+
+import argparse
+
+from distegnn_tpu.data.nbody_sim import generate_nbody_files
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--path", type=str, default="data")
+    p.add_argument("--num-train", type=int, default=5000)
+    p.add_argument("--num-valid", type=int, default=2000)
+    p.add_argument("--num-test", type=int, default=2000)
+    p.add_argument("--length", type=int, default=5000)
+    p.add_argument("--sample-freq", type=int, default=100)
+    p.add_argument("--n_isolated", type=int, default=100)
+    p.add_argument("--n_stick", type=int, default=0)
+    p.add_argument("--n_hinge", type=int, default=0)
+    p.add_argument("--clusters", type=int, default=1)
+    p.add_argument("--seed", type=int, default=43)
+    p.add_argument("--suffix", type=str, default="")
+    p.add_argument("--box_size", type=float, default=None)
+    args = p.parse_args()
+
+    out = generate_nbody_files(
+        args.path,
+        n_isolated=args.n_isolated, n_stick=args.n_stick, n_hinge=args.n_hinge,
+        clusters=args.clusters, num_train=args.num_train, num_valid=args.num_valid,
+        num_test=args.num_test, length=args.length, sample_freq=args.sample_freq,
+        seed=args.seed, suffix=args.suffix, box_size=args.box_size,
+    )
+    print(f"Generated: {out} -> {args.path}")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,155 @@
+"""Training-runtime tests: loss parity vs dense numpy/torch references,
+optimizer parity vs torch.Adam, grad accumulation, checkpoint roundtrip, and a
+loss-goes-down smoke run (SURVEY.md §4: the test infrastructure the reference
+lacks)."""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from distegnn_tpu.data import GraphDataset, GraphLoader, build_nbody_graph
+from distegnn_tpu.models.fast_egnn import FastEGNN
+from distegnn_tpu.ops.graph import pad_graphs
+from distegnn_tpu.train import (
+    TrainState,
+    make_eval_step,
+    make_optimizer,
+    make_train_step,
+    masked_mse,
+    mmd_loss,
+    restore_checkpoint,
+    save_checkpoint,
+)
+
+
+def _tiny_dataset(rng, n_graphs=8, n=10):
+    graphs = []
+    for _ in range(n_graphs):
+        loc = rng.normal(size=(n, 3))
+        vel = rng.normal(size=(n, 3))
+        charges = rng.choice([1.0, -1.0], size=(n, 1))
+        target = loc + 0.1 * vel
+        graphs.append(build_nbody_graph(loc, vel, charges, target, radius=-1.0, cutoff_rate=0.0))
+    return graphs
+
+
+def test_masked_mse_matches_numpy(rng):
+    pred = rng.normal(size=(2, 6, 3)).astype(np.float32)
+    target = rng.normal(size=(2, 6, 3)).astype(np.float32)
+    mask = np.array([[1, 1, 1, 1, 0, 0], [1, 1, 1, 1, 1, 1]], np.float32)
+    got = float(masked_mse(jnp.asarray(pred), jnp.asarray(target), jnp.asarray(mask)))
+    real = np.concatenate([(pred[0, :4] - target[0, :4]).ravel(), (pred[1] - target[1]).ravel()])
+    np.testing.assert_allclose(got, np.mean(real**2), rtol=1e-5)
+
+
+def test_mmd_loss_matches_dense_reference(rng):
+    # With samples*C >= N every real node is drawn (Gumbel top-k over N nodes),
+    # so the sampled set equals the node set and the loss is deterministic —
+    # compare against a direct numpy transcription of reference kernel math
+    # (utils/train.py:11-14,119-145).
+    B, N, C, sigma, samples = 2, 4, 2, 1.5, 2  # num_sample = 4 = N
+    V = rng.normal(size=(B, 3, C)).astype(np.float32)
+    target = rng.normal(size=(B, N, 3)).astype(np.float32)
+    mask = np.ones((B, N), np.float32)
+    got = float(mmd_loss(jnp.asarray(V), jnp.asarray(target), jnp.asarray(mask),
+                         jax.random.PRNGKey(0), sigma, samples))
+
+    def k(x, y):
+        d = np.linalg.norm(x[:, None] - y[None, :], axis=-1)
+        return np.exp(-d / (2 * sigma * sigma))
+
+    num_sample = samples * C
+    l_vv = sum(k(V[b].T, V[b].T).sum() for b in range(B)) / B / C / C
+    l_rv = 2 * sum(k(target[b], V[b].T).sum() for b in range(B)) / B / num_sample / C
+    np.testing.assert_allclose(got, l_vv - l_rv, rtol=1e-4)
+
+
+def test_optimizer_matches_torch_adam():
+    # same quadratic, same init: optax chain must track torch.Adam(+wd) steps
+    import torch
+
+    w0 = np.array([1.0, -2.0, 3.0], np.float32)
+    tw = torch.nn.Parameter(torch.tensor(w0))
+    topt = torch.optim.Adam([tw], lr=1e-2, weight_decay=1e-2)
+    for _ in range(5):
+        topt.zero_grad()
+        loss = (tw**2).sum()
+        loss.backward()
+        topt.step()
+
+    tx = make_optimizer(1e-2, weight_decay=1e-2)
+    params = jnp.asarray(w0)
+    opt_state = tx.init(params)
+    for _ in range(5):
+        grads = jax.grad(lambda p: jnp.sum(p**2))(params)
+        updates, opt_state = tx.update(grads, opt_state, params)
+        params = params + updates["params"] if isinstance(updates, dict) else params + updates
+    np.testing.assert_allclose(np.asarray(params), tw.detach().numpy(), rtol=1e-5, atol=1e-6)
+
+
+def test_grad_accumulation_equals_mean():
+    # MultiSteps(k=2) applied to two micro-grads == single step on their mean
+    tx_acc = make_optimizer(1e-2, accumulation_steps=2)
+    tx_ref = make_optimizer(1e-2)
+    p = jnp.asarray([1.0, 2.0])
+    g1, g2 = jnp.asarray([0.5, -1.0]), jnp.asarray([1.5, 3.0])
+
+    s = tx_acc.init(p)
+    pa = p
+    for g in (g1, g2):
+        u, s = tx_acc.update(g, s, pa)
+        pa = pa + u
+    sr = tx_ref.init(p)
+    u, _ = tx_ref.update((g1 + g2) / 2, sr, p)
+    pr = p + u
+    np.testing.assert_allclose(np.asarray(pa), np.asarray(pr), rtol=1e-6)
+
+
+@pytest.fixture(scope="module")
+def tiny_setup():
+    rng = np.random.default_rng(0)
+    graphs = _tiny_dataset(rng)
+    batch = pad_graphs(graphs[:4])
+    model = FastEGNN(node_feat_nf=2, hidden_nf=16, virtual_channels=3, n_layers=2)
+    params = model.init(jax.random.PRNGKey(0), batch)
+    return model, params, graphs
+
+
+def test_train_step_loss_decreases(tiny_setup):
+    model, params, graphs = tiny_setup
+    tx = make_optimizer(5e-3)
+    state = TrainState.create(params, tx)
+    step = jax.jit(make_train_step(model, tx, mmd_weight=0.03, mmd_sigma=1.5, mmd_samples=3))
+    ds = GraphDataset(graphs)
+    loader = GraphLoader(ds, batch_size=4, shuffle=True, seed=1)
+    first = last = None
+    for epoch in range(15):
+        loader.set_epoch(epoch)
+        for i, batch in enumerate(loader):
+            state, m = step(state, batch, jax.random.PRNGKey(epoch * 100 + i))
+            if first is None:
+                first = float(m["loss"])
+            last = float(m["loss"])
+    assert last < first * 0.5, f"loss did not decrease: {first} -> {last}"
+
+
+def test_eval_step_runs(tiny_setup):
+    model, params, graphs = tiny_setup
+    ev = jax.jit(make_eval_step(model))
+    batch = pad_graphs(graphs[:4])
+    loss = float(ev(params, batch))
+    assert np.isfinite(loss) and loss > 0
+
+
+def test_checkpoint_roundtrip(tmp_path, tiny_setup):
+    model, params, _ = tiny_setup
+    tx = make_optimizer(1e-3, weight_decay=1e-8)
+    state = TrainState.create(params, tx)
+    path = str(tmp_path / "ckpt" / "best_model.ckpt")
+    save_checkpoint(path, state, epoch=7, losses={"loss_valid": 0.5}, config={"a": 1})
+    fresh = TrainState.create(params, tx)
+    restored, epoch, losses = restore_checkpoint(path, fresh)
+    assert epoch == 7 and losses["loss_valid"] == 0.5
+    for a, b in zip(jax.tree.leaves(state.params), jax.tree.leaves(restored.params)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))

@@ -1,0 +1,22 @@
+"""Data layer (L1+L2): offline n-body simulator, per-dataset preprocessing
+pipelines, and static-shape loaders (reference dataset_generation/** and
+datasets/process_dataset.py)."""
+
+from distegnn_tpu.data.loader import GraphDataset, GraphLoader, ShardedGraphLoader
+from distegnn_tpu.data.nbody import build_nbody_graph, process_nbody_cutoff
+from distegnn_tpu.data.nbody_sim import (
+    ChargedSystem,
+    generate_nbody_files,
+    simulate_trajectory,
+)
+
+__all__ = [
+    "ChargedSystem",
+    "GraphDataset",
+    "GraphLoader",
+    "ShardedGraphLoader",
+    "build_nbody_graph",
+    "generate_nbody_files",
+    "process_nbody_cutoff",
+    "simulate_trajectory",
+]

@@ -1,0 +1,142 @@
+"""Static-shape graph loaders.
+
+The reference wraps processed lists in a PyG DataLoader with drop_last=True and
+a seeded RandomSampler so every rank draws the same graph order
+(reference main.py:184-190, datasets/process_dataset.py:582-596). Here loaders
+collate into padded ``GraphBatch``es with dataset-wide N/E maxima fixed at
+construction, so every batch of an epoch shares ONE compiled XLA program —
+the TPU-first replacement for ragged PyG batching.
+"""
+
+from __future__ import annotations
+
+import pickle
+from typing import List, Sequence, Union
+
+import numpy as np
+import jax
+
+from distegnn_tpu.ops.graph import GraphBatch, _round_up, pad_graphs
+
+
+class GraphDataset:
+    """A list of graph dicts, from a processed pickle file or in memory
+    (reference DatasetWrapper, datasets/process_dataset.py:582-596)."""
+
+    def __init__(self, source: Union[str, Sequence[dict]]):
+        if isinstance(source, str):
+            with open(source, "rb") as f:
+                self.graphs: List[dict] = pickle.load(f)
+        else:
+            self.graphs = list(source)
+
+    def __len__(self) -> int:
+        return len(self.graphs)
+
+    def __getitem__(self, i: int) -> dict:
+        return self.graphs[i]
+
+    def size_maxima(self):
+        n = max(g["loc"].shape[0] for g in self.graphs)
+        e = max(g["edge_index"].shape[1] for g in self.graphs)
+        return n, e
+
+
+class GraphLoader:
+    """Deterministic batching: permutation from (seed, epoch) only, so every
+    host draws identical order (the invariant the reference checks per step
+    with an all_gather, utils/train.py:55-61 — here it holds by construction).
+    drop_last always (reference main.py:186)."""
+
+    def __init__(
+        self,
+        dataset: GraphDataset,
+        batch_size: int,
+        shuffle: bool = False,
+        seed: int = 0,
+        node_bucket: int = 8,
+        edge_bucket: int = 128,
+        max_nodes: int = None,
+        max_edges: int = None,
+    ):
+        self.dataset = dataset
+        self.batch_size = batch_size
+        self.shuffle = shuffle
+        self.seed = seed
+        self.epoch = 0
+        if max_nodes is None or max_edges is None:
+            n, e = dataset.size_maxima()
+            max_nodes = max_nodes if max_nodes is not None else _round_up(n, node_bucket)
+            max_edges = max_edges if max_edges is not None else _round_up(e, edge_bucket)
+        self.max_nodes, self.max_edges = max_nodes, max_edges
+        if len(self) == 0:
+            raise ValueError(
+                f"batch_size {batch_size} > dataset size {len(dataset)}: "
+                "drop_last leaves zero batches"
+            )
+
+    def set_epoch(self, epoch: int) -> None:
+        self.epoch = epoch
+
+    def __len__(self) -> int:
+        return len(self.dataset) // self.batch_size
+
+    def _order(self) -> np.ndarray:
+        if not self.shuffle:
+            return np.arange(len(self.dataset))
+        return np.random.default_rng([self.seed, self.epoch]).permutation(len(self.dataset))
+
+    def __iter__(self):
+        order = self._order()
+        for b in range(len(self)):
+            idx = order[b * self.batch_size:(b + 1) * self.batch_size]
+            yield pad_graphs(
+                [self.dataset[int(i)] for i in idx],
+                max_nodes=self.max_nodes, max_edges=self.max_edges,
+            )
+
+
+class ShardedGraphLoader:
+    """Lockstep loaders over per-partition shards, stacked on a leading
+    partition axis [P, B, ...] — the layout shard_map consumes with the P axis
+    sharded over the mesh's ``graph`` axis. Mirrors the reference's per-rank
+    shard files + identical seeded order (main.py:182-190); shards share one
+    N/E maximum so the stack is rectangular."""
+
+    def __init__(
+        self,
+        datasets: Sequence[GraphDataset],
+        batch_size: int,
+        shuffle: bool = False,
+        seed: int = 0,
+        node_bucket: int = 8,
+        edge_bucket: int = 128,
+    ):
+        sizes = {len(d) for d in datasets}
+        if len(sizes) != 1:
+            raise ValueError(f"shards must be equal length, got {sorted(sizes)}")
+        maxima = [d.size_maxima() for d in datasets]
+        n = max(m[0] for m in maxima)
+        e = max(m[1] for m in maxima)
+        self.loaders = [
+            GraphLoader(
+                d, batch_size, shuffle=shuffle, seed=seed,
+                max_nodes=_round_up(n, node_bucket), max_edges=_round_up(e, edge_bucket),
+            )
+            for d in datasets
+        ]
+
+    @property
+    def num_partitions(self) -> int:
+        return len(self.loaders)
+
+    def set_epoch(self, epoch: int) -> None:
+        for l in self.loaders:
+            l.set_epoch(epoch)
+
+    def __len__(self) -> int:
+        return len(self.loaders[0])
+
+    def __iter__(self):
+        for parts in zip(*self.loaders):
+            yield jax.tree.map(lambda *xs: np.stack(xs, axis=0), *parts)

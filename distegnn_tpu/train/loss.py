@@ -56,7 +56,9 @@ def mmd_loss(
 ) -> jnp.ndarray:
     """loss_mmd = l_vv - l_rv (reference normalizations, utils/train.py:141-145)."""
     B, _, C = virtual_loc.shape
-    num_sample = samples * C
+    # top_k needs k <= N; when the padded node axis is shorter than samples*C
+    # the whole node set is drawn (valid-mask weights handle the rest)
+    num_sample = min(samples * C, target.shape[1])
     V = jnp.swapaxes(virtual_loc, 1, 2)  # [B, C, 3]
 
     def per_graph(key_b, target_b, mask_b, V_b):

@@ -1,0 +1,94 @@
+"""Benchmark: LargeFluid-scale training-step throughput, nodes/sec/chip.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+Workload (BASELINE.md protocol): Fluid113K shape — 113,140 nodes, ~1.7M
+radius-0.075 edges, batch 1, FastEGNN hidden 64 / 4 layers / C=3 with MMD
+(sigma 3, w 0.01, n 50) and grad clip 0.3 — the largefluid_distegnn.yaml
+configuration on one chip. vs_baseline divides by the round-1 TPU v5e anchor
+measured with this same script, so the number tracks our own progress
+(the reference publishes no GPU throughput; see BASELINE.md)."""
+
+from __future__ import annotations
+
+import json
+import time
+
+import numpy as np
+
+# Round-1 anchor: first measurement of this script on the single TPU v5e chip
+# (2026-07-29, step 166.9ms at N=113140/E=1639080).
+BASELINE_NODES_PER_SEC = 677_764.7
+
+N_NODES = 113_140
+RADIUS = 0.075
+TARGET_EDGES_PER_NODE = 15.0
+HIDDEN, LAYERS, CHANNELS = 64, 4, 3
+WARMUP, STEPS = 3, 10
+
+
+def make_fluid_batch(rng):
+    """Synthetic fluid-like particle cloud at Fluid113K density."""
+    from distegnn_tpu.ops.graph import pad_graphs
+    from distegnn_tpu.ops.radius import radius_graph_np
+
+    vol = N_NODES * (4.0 / 3.0) * np.pi * RADIUS**3 / TARGET_EDGES_PER_NODE
+    side = vol ** (1.0 / 3.0)
+    loc = rng.uniform(0, side, size=(N_NODES, 3)).astype(np.float32)
+    vel = rng.normal(size=(N_NODES, 3)).astype(np.float32) * 0.01
+    edge_index = radius_graph_np(loc, RADIUS)
+    dist = np.linalg.norm(loc[edge_index[0]] - loc[edge_index[1]], axis=1)
+    graph = {
+        "node_feat": np.concatenate(
+            [np.linalg.norm(vel, axis=1, keepdims=True), vel[:, :2]], axis=1
+        ).astype(np.float32),                       # 3 features (largefluid config)
+        "node_attr": np.ones((N_NODES, 2), np.float32),  # viscosity, mass
+        "loc": loc,
+        "vel": vel,
+        "target": loc + vel * 0.05,
+        "loc_mean": loc.mean(axis=0),
+        "edge_index": edge_index.astype(np.int32),
+        "edge_attr": np.repeat(dist[:, None], 2, axis=1).astype(np.float32),
+    }
+    return pad_graphs([graph]), edge_index.shape[1]
+
+
+def main():
+    import jax
+
+    from distegnn_tpu.models.fast_egnn import FastEGNN
+    from distegnn_tpu.train import TrainState, make_optimizer, make_train_step
+
+    rng = np.random.default_rng(0)
+    batch, n_edges = make_fluid_batch(rng)
+
+    model = FastEGNN(node_feat_nf=3, node_attr_nf=2, edge_attr_nf=2,
+                     hidden_nf=HIDDEN, virtual_channels=CHANNELS, n_layers=LAYERS)
+    params = model.init(jax.random.PRNGKey(0), batch)
+    tx = make_optimizer(5e-4, weight_decay=1e-12, clip_norm=0.3)
+    state = TrainState.create(params, tx)
+    step = jax.jit(make_train_step(model, tx, mmd_weight=0.01, mmd_sigma=3.0,
+                                   mmd_samples=50), donate_argnums=0)
+
+    for i in range(WARMUP):
+        state, metrics = step(state, batch, jax.random.PRNGKey(i))
+    jax.block_until_ready(metrics["loss"])
+
+    t0 = time.perf_counter()
+    for i in range(STEPS):
+        state, metrics = step(state, batch, jax.random.PRNGKey(100 + i))
+    jax.block_until_ready(metrics["loss"])
+    dt = time.perf_counter() - t0
+
+    nodes_per_sec = N_NODES * STEPS / dt
+    vs = 1.0 if BASELINE_NODES_PER_SEC is None else nodes_per_sec / BASELINE_NODES_PER_SEC
+    print(json.dumps({
+        "metric": "largefluid_train_nodes_per_sec_per_chip",
+        "value": round(nodes_per_sec, 1),
+        "unit": f"nodes/sec/chip (N={N_NODES}, E={n_edges}, step={dt / STEPS * 1e3:.1f}ms)",
+        "vs_baseline": round(vs, 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()

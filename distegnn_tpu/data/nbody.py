@@ -26,19 +26,27 @@ def build_nbody_graph(
     target: Optional[np.ndarray],
     radius: float = -1.0,
     cutoff_rate: float = 0.0,
+    with_edges: bool = True,
 ) -> dict:
     """One sample -> graph dict (reference process_key,
     process_dataset.py:90-115): full graph when radius == -1 else radius
     graph; drop the longest cutoff_rate fraction; edge_attr = distance
     duplicated to 2 channels; node_feat = [|v|, q / max q]; node_attr = q;
-    loc_mean = mean position (the virtual-node seed)."""
+    loc_mean = mean position (the virtual-node seed).
+
+    with_edges=False skips edge construction (empty edge list) — for
+    distribute mode, which drops whole-graph edges and rebuilds per-partition
+    inner_radius edges anyway (building the O(n^2) full set would be waste)."""
     loc = np.asarray(loc, np.float32)
     vel = np.asarray(vel, np.float32)
     charges = np.asarray(charges, np.float32)
     n = loc.shape[0]
 
-    edge_index = full_graph_np(n) if radius == -1 else radius_graph_np(loc, radius)
-    edge_index = cutoff_edges_np(edge_index, loc, cutoff_rate)
+    if with_edges:
+        edge_index = full_graph_np(n) if radius == -1 else radius_graph_np(loc, radius)
+        edge_index = cutoff_edges_np(edge_index, loc, cutoff_rate)
+    else:
+        edge_index = np.zeros((2, 0), np.int64)
     dist = np.linalg.norm(loc[edge_index[0]] - loc[edge_index[1]], axis=1)
     edge_attr = np.repeat(dist[:, None], 2, axis=1).astype(np.float32)
 

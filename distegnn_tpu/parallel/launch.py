@@ -1,0 +1,167 @@
+"""Distributed (DistEGNN) execution: one jitted shard_map'd train step over the
+mesh's ``graph`` axis.
+
+Replaces the reference's torchrun + NCCL + DDP stack (reference
+main.py:159-229): there, one OS process per GPU runs the same Python loop and
+synchronizes through process-group collectives; here ONE program traces the
+step once, shard_map lays the partition axis over devices, and the three
+per-layer virtual-node psums plus the node-count loss psum are XLA collectives
+riding ICI. Gradient sync is an explicit psum of per-partition gradients
+inside the step (see distegnn_tpu/train/step.py) — the DDP-sum pattern — so
+every device applies the identical optimizer update and weights stay
+replicated by construction (the invariant the reference checks with
+broadcast+allclose at startup, main.py:40-55).
+
+Multi-host: call ``jax.distributed.initialize()`` first; the same shard_map
+spans the global mesh and XLA routes the collectives over ICI/DCN.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional
+
+import jax
+import numpy as np
+from jax.sharding import PartitionSpec as P
+
+from distegnn_tpu.parallel.mesh import GRAPH_AXIS, make_mesh
+from distegnn_tpu.train import (
+    TrainState,
+    make_eval_step,
+    make_optimizer,
+    make_train_step,
+    needs_grad_clip,
+    restore_checkpoint,
+    train,
+)
+
+
+def make_distributed_steps(model, tx, mesh, mmd_weight: float, mmd_sigma: float,
+                           mmd_samples: int):
+    """Build jitted (train_step, eval_step) running under shard_map.
+
+    Batch arrays arrive [P, B, ...] (ShardedGraphLoader layout); the leading
+    axis shards over GRAPH_AXIS so each device sees its partition's [B, ...]
+    slice. State and PRNG key are replicated; outputs (replicated state,
+    psum'd scalars) come back as single copies.
+    """
+    step = make_train_step(model, tx, mmd_weight=mmd_weight, mmd_sigma=mmd_sigma,
+                           mmd_samples=mmd_samples, axis_name=GRAPH_AXIS)
+    ev = make_eval_step(model, axis_name=GRAPH_AXIS)
+
+    def _step_one(state, batch, key):
+        # strip the leading partition axis (size 1 per device under shard_map)
+        b = jax.tree.map(lambda x: x[0], batch)
+        return step(state, b, key)
+
+    def _eval_one(params, batch):
+        return ev(params, jax.tree.map(lambda x: x[0], batch))
+
+    train_step = jax.jit(jax.shard_map(
+        _step_one, mesh=mesh,
+        in_specs=(P(), P(GRAPH_AXIS), P()),
+        out_specs=(P(), P()),
+        check_vma=False,
+    ))
+    eval_step = jax.jit(jax.shard_map(
+        _eval_one, mesh=mesh,
+        in_specs=(P(), P(GRAPH_AXIS)),
+        out_specs=P(),
+        check_vma=False,
+    ))
+    return train_step, eval_step
+
+
+def run_distributed(config):
+    """Distribute-mode entry (reference main.py distribute flow): partitioned
+    shards -> ShardedGraphLoader -> shard_map'd jitted step -> shared outer
+    training loop."""
+    from distegnn_tpu.config import derive_runtime_fields
+    from distegnn_tpu.data import GraphDataset, ShardedGraphLoader
+    from distegnn_tpu.data.distribute import process_nbody_distribute
+    from distegnn_tpu.models.registry import get_model
+    from distegnn_tpu.utils.seed import fix_seed
+
+    ws = config.data.get("world_size") or len(jax.devices())
+    if ws > len(jax.devices()):
+        raise ValueError(f"world_size {ws} > available devices {len(jax.devices())}")
+    derive_runtime_fields(config, world_size=ws)
+    fix_seed(config.seed)
+    mesh = make_mesh(n_graph=ws, devices=jax.devices()[:ws])
+
+    d = config.data
+    name = d.dataset_name
+    if name.startswith("nbody"):
+        split_paths = process_nbody_distribute(
+            d.data_dir, name, ws, d.max_samples, d.inner_radius, d.outer_radius,
+            d.split_mode, d.frame_0, d.frame_T, seed=config.seed,
+        )
+    elif name == "Water-3D":
+        try:
+            from distegnn_tpu.data.water3d import process_water3d_distribute
+        except ImportError as e:
+            raise NotImplementedError("Water-3D pipeline not built yet (SURVEY.md §7.2 stage 8)") from e
+
+        split_paths = process_water3d_distribute(
+            d.data_dir, name, ws, d.max_samples, d.inner_radius, d.outer_radius,
+            d.split_mode, d.delta_t, seed=config.seed,
+        )
+    elif name in ("Fluid113K", "LargeFluid"):
+        try:
+            from distegnn_tpu.data.fluid113k import process_large_fluid_distribute
+        except ImportError as e:
+            raise NotImplementedError("Fluid113K pipeline not built yet (SURVEY.md §7.2 stage 8)") from e
+
+        split_paths = process_large_fluid_distribute(
+            d.data_dir, name, ws, d.max_samples, d.inner_radius, d.outer_radius,
+            d.split_mode, d.delta_t, seed=config.seed,
+        )
+    else:
+        raise NotImplementedError(f"{name} has no distribute-mode processor")
+
+    loaders = []
+    for split_idx, paths in enumerate(split_paths):
+        datasets = [GraphDataset(p) for p in paths]
+        loaders.append(ShardedGraphLoader(
+            datasets, d.batch_size, shuffle=(split_idx == 0), seed=config.seed,
+            node_bucket=d.node_bucket, edge_bucket=d.edge_bucket,
+        ))
+    loader_train, loader_valid, loader_test = loaders
+    print(f"Data ready: {len(loader_train.loaders[0].dataset)} graphs x {ws} partitions")
+
+    model = get_model(config.model, world_size=ws, dataset_name=name, axis_name=GRAPH_AXIS)
+    sample = next(iter(loader_train))
+    # init outside shard_map: the axis name is unbound there, and the param
+    # tree is identical either way (axis_name only routes psums)
+    params = model.copy(axis_name=None).init(
+        jax.random.PRNGKey(config.seed), jax.tree.map(lambda x: x[0], sample))
+    n_params = sum(int(np.prod(p.shape)) for p in jax.tree.leaves(params))
+    print(f"Model: {config.model.model_name}, {n_params} parameters, mesh graph={ws}")
+
+    total_steps = config.train.epochs * len(loader_train) // config.train.accumulation_steps
+    clip = 0.3 if needs_grad_clip(config) else None
+    tx = make_optimizer(
+        config.train.learning_rate, weight_decay=config.train.weight_decay,
+        clip_norm=clip, accumulation_steps=config.train.accumulation_steps,
+        total_steps=total_steps, scheduler=str(config.train.scheduler),
+    )
+    state = TrainState.create(params, tx)
+    start_epoch = 0
+    if config.model.checkpoint:
+        state, start_epoch, _ = restore_checkpoint(config.model.checkpoint, state)
+        print(f"Checkpoint loaded from {config.model.checkpoint} (epoch {start_epoch})")
+
+    is_fast = config.model.model_name.startswith("Fast")
+    mmd_w = config.train.mmd.weight if is_fast else 0.0
+    train_step, eval_step = make_distributed_steps(
+        model, tx, mesh, mmd_weight=mmd_w,
+        mmd_sigma=config.train.mmd.sigma, mmd_samples=config.train.mmd.samples,
+    )
+
+    state, best_state, best, log_dict = train(
+        state, train_step, eval_step, loader_train, loader_valid, loader_test,
+        config, start_epoch=start_epoch,
+    )
+    print(f"Done. Best: {best}")
+    return best

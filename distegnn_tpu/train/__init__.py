@@ -1,13 +1,19 @@
 """Training runtime (L5): jitted step, losses, checkpointing, outer loop."""
 
 from distegnn_tpu.train.checkpoint import restore_checkpoint, save_checkpoint
-from distegnn_tpu.train.loss import masked_mse, mmd_loss, weighted_global_loss
+from distegnn_tpu.train.loss import (
+    masked_mse,
+    mmd_loss,
+    weighted_global_loss,
+    weighted_local_loss,
+)
 from distegnn_tpu.train.step import (
     TrainState,
     make_eval_step,
     make_loss_fn,
     make_optimizer,
     make_train_step,
+    needs_grad_clip,
 )
 from distegnn_tpu.train.trainer import run_epoch_eval, run_epoch_train, train
 
@@ -17,9 +23,11 @@ __all__ = [
     "make_loss_fn",
     "make_train_step",
     "make_eval_step",
+    "needs_grad_clip",
     "masked_mse",
     "mmd_loss",
     "weighted_global_loss",
+    "weighted_local_loss",
     "save_checkpoint",
     "restore_checkpoint",
     "train",

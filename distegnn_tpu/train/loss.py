@@ -79,14 +79,28 @@ def mmd_loss(
     return l_vv - l_rv
 
 
+def weighted_local_loss(
+    local_loss: jnp.ndarray,
+    node_mask: jnp.ndarray,
+    axis_name: Optional[str] = None,
+) -> jnp.ndarray:
+    """This partition's node-weighted share of the global loss:
+    local_loss * node_cnt / total_node_cnt (reference utils/train.py:100-110).
+    NOT summed across partitions — differentiate THIS and psum the parameter
+    gradients (the DDP-sum pattern): seeding each device's backward from the
+    psum'd global loss instead would scale every cotangent by the axis size,
+    because the transpose of psum is psum."""
+    node_cnt = jnp.sum(node_mask)
+    total = _psum(node_cnt, axis_name)
+    return local_loss * node_cnt / jnp.maximum(total, 1.0)
+
+
 def weighted_global_loss(
     local_loss: jnp.ndarray,
     node_mask: jnp.ndarray,
     axis_name: Optional[str] = None,
 ) -> jnp.ndarray:
-    """Scale a per-partition loss by node_cnt/total_node_cnt and SUM across
-    partitions (reference utils/train.py:100-110 + the world_size rescale).
-    Single-device this is the identity."""
-    node_cnt = jnp.sum(node_mask)
-    total = _psum(node_cnt, axis_name)
-    return _psum(local_loss * node_cnt / jnp.maximum(total, 1.0), axis_name)
+    """Node-weighted global loss summed across partitions — the logged/eval
+    quantity (reference total_loss_loc, utils/train.py:112-114). Single-device
+    this is the identity."""
+    return _psum(weighted_local_loss(local_loss, node_mask, axis_name), axis_name)

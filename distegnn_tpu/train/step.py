@@ -3,11 +3,13 @@ optimizer, all in ONE traced program (SURVEY.md §7.1 item 2: the reference's
 per-step Python work must become traced ops or disappear).
 
 Distributed: the same step function runs under ``shard_map`` with
-``axis_name='graph'`` — the model's virtual-node psums and the loss's
-node-count psum handle cross-partition exactness; parameter gradients come out
-identical on every device because the global loss already sums over the axis
-(reference achieves the same with DDP allreduce + a world_size rescale,
-main.py:196 + utils/train.py:110).
+``axis_name='graph'``. Each device differentiates its OWN node-weighted loss
+share (cross-partition terms arrive through the model's virtual-node psums),
+then the step psums the parameter gradients across the axis — the DDP-sum
+pattern (reference DDP allreduce + world_size rescale, main.py:196 +
+utils/train.py:110). Do NOT seed the backward from the psum'd global loss
+instead: psum's transpose is psum, which would scale every gradient by the
+axis size.
 
 Optimizer parity (reference main.py:197-202 + utils/train.py:150-158):
 torch.Adam with L2 weight_decay folded into the gradient, optional
@@ -26,7 +28,12 @@ import optax
 from flax import struct
 
 from distegnn_tpu.ops.graph import GraphBatch
-from distegnn_tpu.train.loss import masked_mse, mmd_loss, weighted_global_loss
+from distegnn_tpu.train.loss import (
+    masked_mse,
+    mmd_loss,
+    weighted_global_loss,
+    weighted_local_loss,
+)
 
 
 @struct.dataclass
@@ -38,6 +45,14 @@ class TrainState:
     @classmethod
     def create(cls, params, tx: optax.GradientTransformation) -> "TrainState":
         return cls(params=params, opt_state=tx.init(params), step=jnp.zeros((), jnp.int32))
+
+
+def needs_grad_clip(config) -> bool:
+    """Reference rule (utils/train.py:153-154): clip-by-norm 0.3 only when
+    distributed or on the largest dataset, and only for FastEGNN."""
+    dist = config.data.world_size > 1
+    big = config.data.dataset_name in ("LargeFluid", "Fluid113K")
+    return (dist or big) and config.model.model_name == "FastEGNN"
 
 
 def make_optimizer(
@@ -72,26 +87,33 @@ def make_optimizer(
 
 def make_loss_fn(model, mmd_weight: float, mmd_sigma: float, mmd_samples: int,
                  axis_name: Optional[str] = None) -> Callable:
-    """loss(params, batch, key) -> (loss_for_grad, logged_mse).
+    """loss(params, batch, key) -> (local_loss_for_grad, logged_global_mse).
 
-    loss_for_grad sums over partitions (exact global gradient); logged_mse is
-    the node-weighted global MSE the reference logs (total_loss_loc)."""
+    The grad path carries only THIS partition's weighted share; the train step
+    psums the resulting parameter gradients across the axis (DDP-sum pattern —
+    differentiating the psum'd global loss instead would scale gradients by
+    the axis size, since psum's transpose is psum). logged_global_mse is the
+    node-weighted global MSE the reference logs (total_loss_loc)."""
 
     def loss_fn(params, batch: GraphBatch, key):
         loc_pred, virtual_loc = model.apply(params, batch)
         mse_local = masked_mse(loc_pred, batch.target, batch.node_mask)
-        loss = weighted_global_loss(mse_local, batch.node_mask, axis_name)
-        logged = loss
+        loss = weighted_local_loss(mse_local, batch.node_mask, axis_name)
+        logged = _psum_scalar(loss, axis_name)
         if mmd_weight:
             if axis_name is not None:
                 # independent sample draw per partition (each rank samples its
                 # own local nodes, reference utils/train.py:124-139)
                 key = jax.random.fold_in(key, jax.lax.axis_index(axis_name))
             lm = mmd_loss(virtual_loc, batch.target, batch.node_mask, key, mmd_sigma, mmd_samples)
-            loss = loss + mmd_weight * weighted_global_loss(lm, batch.node_mask, axis_name)
+            loss = loss + mmd_weight * weighted_local_loss(lm, batch.node_mask, axis_name)
         return loss, logged
 
     return loss_fn
+
+
+def _psum_scalar(x, axis_name):
+    return jax.lax.psum(x, axis_name) if axis_name is not None else x
 
 
 def make_train_step(model, tx: optax.GradientTransformation, mmd_weight: float,
@@ -102,10 +124,16 @@ def make_train_step(model, tx: optax.GradientTransformation, mmd_weight: float,
 
     def step(state: TrainState, batch: GraphBatch, key):
         (loss, logged), grads = jax.value_and_grad(loss_fn, has_aux=True)(state.params, batch, key)
+        if axis_name is not None:
+            # DDP-style gradient sum: each device holds the gradient of ITS
+            # partition's loss share (incl. cross-device terms routed through
+            # the model's virtual-node psums); summing yields the exact global
+            # gradient, identically on every device — weights stay replicated.
+            grads = jax.lax.psum(grads, axis_name)
         updates, opt_state = tx.update(grads, state.opt_state, state.params)
         params = optax.apply_updates(state.params, updates)
         new_state = TrainState(params=params, opt_state=opt_state, step=state.step + 1)
-        return new_state, {"loss": logged, "loss_with_mmd": loss}
+        return new_state, {"loss": logged, "loss_with_mmd": _psum_scalar(loss, axis_name)}
 
     return step
 

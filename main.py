@@ -25,6 +25,7 @@ from distegnn_tpu.train import (
     make_eval_step,
     make_optimizer,
     make_train_step,
+    needs_grad_clip,
     restore_checkpoint,
     train,
 )
@@ -66,14 +67,6 @@ def process_dataset_edge_cutoff(data_cfg):
             data_cfg.delta_t, data_cfg.cutoff_rate,
         )
     raise NotImplementedError(f"{name} has no cutoff-mode processor")
-
-
-def needs_grad_clip(config) -> bool:
-    """Reference rule (utils/train.py:153-154): clip 0.3 only when distributed
-    or on the largest dataset, and only for FastEGNN."""
-    dist = config.data.world_size > 1
-    big = config.data.dataset_name in ("LargeFluid", "Fluid113K")
-    return (dist or big) and config.model.model_name == "FastEGNN"
 
 
 def main(argv=None):

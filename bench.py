@@ -81,7 +81,7 @@ def main():
     dt = time.perf_counter() - t0
 
     nodes_per_sec = N_NODES * STEPS / dt
-    vs = 1.0 if BASELINE_NODES_PER_SEC is None else nodes_per_sec / BASELINE_NODES_PER_SEC
+    vs = nodes_per_sec / BASELINE_NODES_PER_SEC
     print(json.dumps({
         "metric": "largefluid_train_nodes_per_sec_per_chip",
         "value": round(nodes_per_sec, 1),

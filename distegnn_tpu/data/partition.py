@@ -31,13 +31,12 @@ from distegnn_tpu.ops.radius import radius_graph_np
 
 
 def random_labels(n: int, n_parts: int, rng: np.random.Generator) -> np.ndarray:
-    """Equal-size random chunks of a node permutation."""
+    """Random chunks of a node permutation, balanced to +-1 (the reference
+    dumps the division remainder into the last chunk, distribute_graphs.py:
+    27-29; spreading it keeps shard padding minimal)."""
     labels = np.empty(n, np.int32)
-    perm = rng.permutation(n)
-    chunk = n // n_parts
-    for p in range(n_parts):
-        end = (p + 1) * chunk if p < n_parts - 1 else n
-        labels[perm[p * chunk:end]] = p
+    for p, chunk in enumerate(np.array_split(rng.permutation(n), n_parts)):
+        labels[chunk] = p
     return labels
 
 

@@ -1,0 +1,256 @@
+"""Baseline models (reference models/basic.py, 750 LoC): the scalarization
+O(n)-equivariant nets and the three factory-served baselines — EGNN (with
+velocity), RF_vel, Linear dynamics — plus the plain GNN.
+
+The scalarization trick (EquivariantScalarNet / InvariantScalarNet, reference
+basic.py:194-277): stack input vectors Z [.., 3, K], form the Gram matrix
+Z^T Z [.., K, K] (rotation-invariant), run MLPs on it, and recombine the
+original vectors with predicted coefficients — O(n)-equivariant by
+construction, MXU-friendly (everything is batched matmuls).
+
+Batched GraphBatch layout; all aggregations masked. Baselines return
+(loc_pred, None) — no virtual nodes (the trainer's MMD path is off for them,
+reference utils/train.py:119).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+from flax import linen as nn
+
+from distegnn_tpu.models.common import MLP, TorchDense, coord_head_init, gather_nodes
+from distegnn_tpu.ops.graph import GraphBatch
+from distegnn_tpu.ops.segment import segment_mean
+
+from functools import partial
+
+_leaky = partial(nn.leaky_relu, negative_slope=0.2)
+
+
+class BaseMLP(nn.Module):
+    """2-layer MLP (reference BaseMLP, basic.py:167-191); flat mode switches
+    to tanh with 4x hidden width."""
+
+    hidden_dim: int
+    output_dim: int
+    act: Callable = nn.silu
+    last_act: bool = False
+    residual: bool = False
+    flat: bool = False
+
+    @nn.compact
+    def __call__(self, x):
+        act = jnp.tanh if self.flat else self.act
+        hidden = 4 * self.hidden_dim if self.flat else self.hidden_dim
+        out = MLP([hidden, self.output_dim], act=act, act_last=self.last_act)(x)
+        return x + out if self.residual else out
+
+
+def _gram(Z: jnp.ndarray, norm: bool) -> jnp.ndarray:
+    """Z [..., 3, K] -> flattened Gram [..., K*K], optionally L2-normalized."""
+    K = Z.shape[-1]
+    scalar = jnp.einsum("...dk,...de->...ke", Z, Z)
+    scalar = scalar.reshape(scalar.shape[:-2] + (K * K,))
+    if norm:
+        scalar = scalar / jnp.maximum(jnp.linalg.norm(scalar, axis=-1, keepdims=True), 1e-12)
+    return scalar
+
+
+class EquivariantScalarNet(nn.Module):
+    """vectors [.., 3, K] (+ scalars) -> (equivariant vector [.., 3],
+    invariant scalar [.., H]) (reference basic.py:194-238)."""
+
+    n_vector_input: int
+    hidden_dim: int
+    norm: bool = True
+    flat: bool = True
+
+    @nn.compact
+    def __call__(self, vectors, scalars=None):
+        Z = jnp.stack(vectors, axis=-1) if isinstance(vectors, (list, tuple)) else vectors
+        s = _gram(Z, self.norm)
+        if scalars is not None:
+            s = jnp.concatenate([s, scalars], axis=-1)
+        s = BaseMLP(self.hidden_dim, self.hidden_dim, last_act=True, flat=self.flat,
+                    name="in_scalar_net")(s)
+        coef = BaseMLP(self.hidden_dim, self.n_vector_input, flat=self.flat,
+                       name="out_vector_net")(s)
+        vector = jnp.einsum("...dk,...k->...d", Z, coef)
+        scalar = BaseMLP(self.hidden_dim, self.hidden_dim, flat=self.flat,
+                         name="out_scalar_net")(s)
+        return vector, scalar
+
+
+class InvariantScalarNet(nn.Module):
+    """vectors [.., 3, K] (+ scalars) -> invariant [.., output_dim]
+    (reference basic.py:241-277)."""
+
+    n_vector_input: int
+    hidden_dim: int
+    output_dim: int
+    norm: bool = True
+    last_act: bool = False
+    flat: bool = False
+
+    @nn.compact
+    def __call__(self, vectors, scalars=None):
+        Z = jnp.stack(vectors, axis=-1) if isinstance(vectors, (list, tuple)) else vectors
+        s = _gram(Z, self.norm)
+        if scalars is not None:
+            s = jnp.concatenate([s, scalars], axis=-1)
+        return BaseMLP(self.hidden_dim, self.output_dim, last_act=self.last_act,
+                       flat=self.flat, name="scalar_net")(s)
+
+
+class EGNNLayer(nn.Module):
+    """Scalarization-based EGNN conv with velocity head and the +-100 force
+    clamp (reference EGNN_Layer, basic.py:280-306)."""
+
+    hidden_nf: int
+    edge_attr_nf: int = 0
+    with_v: bool = False
+    flat: bool = False
+    norm: bool = False
+
+    @nn.compact
+    def __call__(self, x, h, v, g: GraphBatch):
+        N = x.shape[1]
+        row, col = g.row, g.col
+        rij = gather_nodes(x, row) - gather_nodes(x, col)                # [B, E, 3]
+        hij = [gather_nodes(h, row), gather_nodes(h, col)]
+        if self.edge_attr_nf:
+            hij.append(g.edge_attr)
+        message = InvariantScalarNet(
+            n_vector_input=1, hidden_dim=self.hidden_nf, output_dim=self.hidden_nf,
+            norm=self.norm, last_act=True, flat=self.flat, name="edge_message_net",
+        )(rij[..., None], scalars=jnp.concatenate(hij, axis=-1))         # [B, E, H]
+        message = message * g.edge_mask[..., None]
+
+        coord_message = BaseMLP(self.hidden_nf, 1, flat=self.flat, name="coord_net")(message)
+        f = rij * coord_message
+        tot_f = jax.vmap(lambda m, r, e: segment_mean(m, r, N, mask=e))(f, row, g.edge_mask)
+        tot_f = jnp.clip(tot_f, -100.0, 100.0)
+
+        if v is not None:
+            x = x + BaseMLP(self.hidden_nf, 1, flat=self.flat, name="node_v_net")(h) * v + tot_f
+        else:
+            x = x + tot_f
+        x = x * g.node_mask[..., None]
+
+        tot_message = jax.vmap(lambda m, r, e: segment_mean(m, r, N, mask=e))(message, row, g.edge_mask)
+        h = BaseMLP(self.hidden_nf, self.hidden_nf, flat=self.flat, name="node_net")(
+            jnp.concatenate([h, tot_message], axis=-1))
+        h = h * g.node_mask[..., None]
+        return x, v, h
+
+
+class EGNN(nn.Module):
+    """EGNN baseline (reference EGNN, basic.py:309-336; factory main.py:82-84
+    with with_v=True). Returns (loc_pred, None)."""
+
+    n_layers: int
+    in_node_nf: int
+    in_edge_nf: int
+    hidden_nf: int
+    with_v: bool = True
+    flat: bool = False
+    norm: bool = False
+
+    @nn.compact
+    def __call__(self, g: GraphBatch) -> Tuple[jnp.ndarray, None]:
+        h = TorchDense(self.hidden_nf, name="embedding")(g.node_feat)
+        x, v = g.loc, (g.vel if self.with_v else None)
+        for i in range(self.n_layers):
+            x, v, h = EGNNLayer(
+                hidden_nf=self.hidden_nf, edge_attr_nf=self.in_edge_nf,
+                with_v=self.with_v, flat=self.flat, norm=self.norm, name=f"layer_{i}",
+            )(x, h, v, g)
+        return x, None
+
+
+class RFVel(nn.Module):
+    """RF baseline (reference RF_vel + GCL_rf_vel, basic.py:413-464): per
+    layer m_ij = (x_i - x_j) * tanh(phi(|x_i - x_j|, e_ij)) with the bias-free
+    xavier(0.001) scalar head, x += mean-agg + v * psi(|v|).
+    Returns (loc_pred, None)."""
+
+    hidden_nf: int
+    edge_attr_nf: int = 0
+    n_layers: int = 4
+
+    @nn.compact
+    def __call__(self, g: GraphBatch) -> Tuple[jnp.ndarray, None]:
+        x, v = g.loc, g.vel
+        vel_norm = jnp.linalg.norm(v + 1e-30, axis=-1, keepdims=True)
+        N = x.shape[1]
+        row, col = g.row, g.col
+        for i in range(self.n_layers):
+            x_diff = gather_nodes(x, row) - gather_nodes(x, col)
+            radial = jnp.sqrt(jnp.sum(x_diff**2, axis=-1, keepdims=True) + 1e-30)
+            e_in = (jnp.concatenate([radial, g.edge_attr], axis=-1)
+                    if self.edge_attr_nf else radial)
+            gate = MLP([self.hidden_nf, 1], act=_leaky, use_bias_last=False,
+                       kernel_init_last=coord_head_init, name=f"phi_{i}")(e_in)
+            m = x_diff * jnp.tanh(gate)
+            agg = jax.vmap(lambda mm, r, e: segment_mean(mm, r, N, mask=e))(m, row, g.edge_mask)
+            x = x + agg
+            x = x + v * MLP([self.hidden_nf, 1], act=_leaky, name=f"coord_mlp_vel_{i}")(vel_norm)
+            x = x * g.node_mask[..., None]
+        return x, None
+
+
+class GNN(nn.Module):
+    """Plain message-passing GNN with a 3-dim decoder (reference GNN_Layer +
+    GNN, basic.py:359-399): non-equivariant baseline; the decoder output is
+    added to input positions."""
+
+    n_layers: int
+    in_node_nf: int
+    in_edge_nf: int
+    hidden_nf: int
+
+    @nn.compact
+    def __call__(self, g: GraphBatch) -> Tuple[jnp.ndarray, None]:
+        N = g.loc.shape[1]
+        row, col = g.row, g.col
+        h = TorchDense(self.hidden_nf, name="embedding")(
+            jnp.concatenate([g.node_feat, g.loc, g.vel], axis=-1))
+        for i in range(self.n_layers):
+            msg_in = [gather_nodes(h, row), gather_nodes(h, col)]
+            if self.in_edge_nf:
+                msg_in.append(g.edge_attr)
+            msg = MLP([self.hidden_nf, self.hidden_nf], act_last=True,
+                      name=f"edge_mlp_{i}")(jnp.concatenate(msg_in, axis=-1))
+            msg = msg * g.edge_mask[..., None]
+            agg = jax.vmap(lambda m, r, e: segment_mean(m, r, N, mask=e))(msg, row, g.edge_mask)
+            h = h + MLP([self.hidden_nf, self.hidden_nf],
+                        name=f"node_mlp_{i}")(jnp.concatenate([h, agg], axis=-1))
+            h = h * g.node_mask[..., None]
+        out = MLP([self.hidden_nf, 3], name="decoder")(h)
+        return g.loc + out * g.node_mask[..., None], None
+
+
+class LinearDynamics(nn.Module):
+    """x + v * t with learnable scalar t (reference Linear_dynamics,
+    basic.py:402-410)."""
+
+    @nn.compact
+    def __call__(self, g: GraphBatch) -> Tuple[jnp.ndarray, None]:
+        t = self.param("time", nn.initializers.ones, (1,))
+        return g.loc + g.vel * t, None
+
+
+class FullMLP(nn.Module):
+    """Flat MLP over concatenated per-node state (reference FullMLP,
+    basic.py:734-749) — the weakest baseline."""
+
+    hidden_nf: int = 64
+
+    @nn.compact
+    def __call__(self, g: GraphBatch) -> Tuple[jnp.ndarray, None]:
+        inp = jnp.concatenate([g.node_feat, g.loc, g.vel], axis=-1)
+        out = MLP([self.hidden_nf, self.hidden_nf, 3], name="mlp")(inp)
+        return g.loc + out * g.node_mask[..., None], None

@@ -1,0 +1,133 @@
+"""FastRF — Radial-Field dynamics + virtual nodes, TPU-native.
+
+Re-design of reference models/FastRF.py (GCL_RF_vel + FastRF, 222 LoC): a
+radial-field layer (no node features — messages are pure functions of
+geometry) augmented with C global virtual nodes; in distributed mode the
+virtual coordinate update is the only cross-partition channel (reference
+FastRF.py:140-144 — its single weighted_average_reduce).
+
+Reference quirks preserved on purpose:
+  - the coordinate mean entering the virtual Gram m_X is the LOCAL
+    (per-partition) mean — the reference does not allreduce it here, unlike
+    FastEGNN (FastRF.py:166 vs FastEGNN.py:258-261);
+  - the layer activation is LeakyReLU(0.2) (GCL_RF_vel's default; FastRF's
+    act_fn=SiLU argument is never forwarded, FastRF.py:52,178-186).
+
+Layout identical to FastEGNN: dense [B,N,...]/[B,E,...] GraphBatch with masks.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from flax import linen as nn
+
+from distegnn_tpu.models.common import MLP, TorchDense, coord_head_init, gather_nodes
+from distegnn_tpu.ops.graph import GraphBatch
+from distegnn_tpu.ops.segment import segment_mean
+from distegnn_tpu.parallel.collectives import global_node_mean
+
+_leaky = partial(nn.leaky_relu, negative_slope=0.2)
+
+
+class _RadialField(nn.Module):
+    """phi: invariants -> tanh'd H-vector; last layer bias-free xavier(0.001)
+    (reference GCL_RF_vel.__init__, FastRF.py:62-76)."""
+
+    hidden_nf: int
+
+    @nn.compact
+    def __call__(self, x):
+        x = MLP([self.hidden_nf, self.hidden_nf], act=_leaky,
+                use_bias_last=False, kernel_init_last=coord_head_init)(x)
+        return jnp.tanh(x)
+
+
+class _ScalarHead(nn.Module):
+    """Linear(H) -> LeakyReLU -> Linear(1) (edge_mlp / edge_mlp_rv / edge_mlp_vr,
+    FastRF.py:79-95)."""
+
+    hidden_nf: int
+
+    @nn.compact
+    def __call__(self, x):
+        return MLP([self.hidden_nf, 1], act=_leaky)(x)
+
+
+class GCLRFVel(nn.Module):
+    """One radial-field conv layer with velocity + virtual channels
+    (reference GCL_RF_vel.forward, FastRF.py:155-172)."""
+
+    hidden_nf: int
+    virtual_channels: int
+    edge_attr_nf: int = 0
+    axis_name: Optional[str] = None
+
+    @nn.compact
+    def __call__(self, x, v, X, g: GraphBatch) -> Tuple[jnp.ndarray, jnp.ndarray]:
+        H, C = self.hidden_nf, self.virtual_channels
+        row = g.row
+        node_mask, edge_mask = g.node_mask, g.edge_mask
+        B, N = x.shape[0], x.shape[1]
+
+        coord_diff = gather_nodes(x, row) - gather_nodes(x, g.col)       # [B, E, 3]
+        radial = jnp.sum(coord_diff**2, axis=-1, keepdims=True)          # [B, E, 1]
+        vcd = X[:, None, :, :] - x[..., None]                            # [B, N, 3, C]
+        virtual_radial = jnp.linalg.norm(vcd, axis=2, keepdims=True)     # [B, N, 1, C]
+
+        e_in = jnp.concatenate([radial, g.edge_attr], axis=-1) if self.edge_attr_nf else radial
+        edge_feat = _RadialField(H, name="phi")(e_in)                    # [B, E, H]
+
+        # LOCAL per-graph coordinate mean (reference keeps this un-reduced)
+        coord_mean = global_node_mean(x, node_mask, axis_name=None)      # [B, 3]
+        Xc = X - coord_mean[:, :, None]
+        m_X = jnp.einsum("bdc,bde->bce", Xc, Xc)                         # [B, C, C]
+
+        v_in = jnp.concatenate(
+            [jnp.swapaxes(virtual_radial, 2, 3),                          # [B, N, C, 1]
+             jnp.broadcast_to(m_X[:, None, :, :], (B, N, C, C))],
+            axis=-1,
+        )
+        vef = _RadialField(H, name="phi_v")(v_in) * node_mask[:, :, None, None]  # [B, N, C, H]
+
+        # real coordinate update (node_model, FastRF.py:119-131)
+        trans = coord_diff * _ScalarHead(H, name="edge_mlp")(edge_feat)
+        agg = jax.vmap(lambda t, r, m: segment_mean(t, r, N, mask=m))(trans, row, edge_mask)
+        trans_v = jnp.mean(-vcd * jnp.swapaxes(_ScalarHead(H, name="edge_mlp_rv")(vef), 2, 3), axis=-1)
+        speed = jnp.linalg.norm(v, axis=-1, keepdims=True)
+        x = x + agg + trans_v + v * MLP([H, 1], act=_leaky, name="coord_mlp_vel")(speed)
+        x = x * node_mask[..., None]
+
+        # virtual coordinate update — the one cross-partition psum
+        # (node_model_virtual, FastRF.py:134-144)
+        trans_X = vcd * jnp.swapaxes(_ScalarHead(H, name="edge_mlp_vr")(vef), 2, 3)
+        X = X + global_node_mean(trans_X, node_mask, self.axis_name)     # [B, 3, C]
+        return x, X
+
+
+class FastRF(nn.Module):
+    """FastRF wrapper (reference FastRF.py:177-194): no embeddings, no node
+    features — n_layers of GCL_RF_vel over (loc, vel, virtual loc)."""
+
+    edge_attr_nf: int = 0
+    hidden_nf: int = 64
+    virtual_channels: int = 3
+    n_layers: int = 4
+    axis_name: Optional[str] = None
+
+    @nn.compact
+    def __call__(self, g: GraphBatch) -> Tuple[jnp.ndarray, jnp.ndarray]:
+        assert self.virtual_channels > 0, "virtual_channels must be > 0"
+        C = self.virtual_channels
+        X = jnp.repeat(g.loc_mean[:, :, None], C, axis=2)                # [B, 3, C]
+        x, v = g.loc, g.vel
+        for i in range(self.n_layers):
+            x, X = GCLRFVel(
+                hidden_nf=self.hidden_nf, virtual_channels=C,
+                edge_attr_nf=self.edge_attr_nf, axis_name=self.axis_name,
+                name=f"gcl_{i}",
+            )(x, v, X, g)
+        return x, X

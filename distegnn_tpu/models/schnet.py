@@ -1,0 +1,132 @@
+"""SchNet baseline with equivariant coordinate updates, TPU-native.
+
+Re-design of reference models/SchNet.py (a PyG SchNet fork, 362 LoC): per
+interaction block the standard continuous-filter feature update PLUS an added
+equivariant coordinate update ``pos += scatter_mean((pos_r - pos_c) *
+Linear([gauss(d), h_r, h_c]))`` (reference SchNet.py:191-198). The feature
+path keeps PyG's pieces: GaussianSmearing distance expansion, CFConv with
+cosine cutoff window, ShiftedSoftplus, xavier/zero-bias inits
+(SchNet.py:271-341). Embedding is a Linear over the 2 node features — the
+reference replaces the atomic-number Embedding (SchNet.py:121-124).
+
+Batched GraphBatch layout; every aggregation masked.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from flax import linen as nn
+
+from distegnn_tpu.models.common import gather_nodes
+from distegnn_tpu.ops.graph import GraphBatch
+from distegnn_tpu.ops.segment import segment_mean, segment_sum
+
+xavier = nn.initializers.xavier_uniform()
+
+
+def shifted_softplus(x):
+    return jax.nn.softplus(x) - float(np.log(2.0))
+
+
+class GaussianSmearing(nn.Module):
+    """exp(-gamma (d - mu_k)^2) distance expansion (reference SchNet.py:344-358)."""
+
+    start: float = 0.0
+    stop: float = 5.0
+    num_gaussians: int = 50
+
+    @nn.compact
+    def __call__(self, dist):
+        offset = jnp.linspace(self.start, self.stop, self.num_gaussians)
+        coeff = -0.5 / float((self.stop - self.start) / (self.num_gaussians - 1)) ** 2
+        return jnp.exp(coeff * (dist[..., None] - offset) ** 2)
+
+
+class CFConv(nn.Module):
+    """Continuous-filter conv: x_i' = lin2(sum_j lin1(x_j) * W(d_ij))
+    with the cosine cutoff window (reference SchNet.py:305-341)."""
+
+    hidden_channels: int
+    num_filters: int
+    cutoff: float
+
+    @nn.compact
+    def __call__(self, h, g: GraphBatch, edge_weight, edge_attr):
+        W = nn.Dense(self.num_filters, kernel_init=xavier, bias_init=nn.initializers.zeros)(edge_attr)
+        W = shifted_softplus(W)
+        W = nn.Dense(self.num_filters, kernel_init=xavier, bias_init=nn.initializers.zeros)(W)
+        C = 0.5 * (jnp.cos(edge_weight * jnp.pi / self.cutoff) + 1.0)
+        W = W * C[..., None] * g.edge_mask[..., None]
+
+        x = nn.Dense(self.num_filters, use_bias=False, kernel_init=xavier)(h)
+        msg = gather_nodes(x, g.col) * W
+        N = h.shape[1]
+        agg = jax.vmap(lambda m, r: segment_sum(m, r, N))(msg, g.row)  # aggr='add'
+        return nn.Dense(self.hidden_channels, kernel_init=xavier, bias_init=nn.initializers.zeros)(agg)
+
+
+class InteractionBlock(nn.Module):
+    """CFConv -> ShiftedSoftplus -> Linear (reference SchNet.py:271-302)."""
+
+    hidden_channels: int
+    num_filters: int
+    cutoff: float
+
+    @nn.compact
+    def __call__(self, h, g: GraphBatch, edge_weight, edge_attr):
+        x = CFConv(self.hidden_channels, self.num_filters, self.cutoff)(h, g, edge_weight, edge_attr)
+        x = shifted_softplus(x)
+        return nn.Dense(self.hidden_channels, kernel_init=xavier, bias_init=nn.initializers.zeros)(x)
+
+
+class SchNet(nn.Module):
+    """Baseline SchNet (reference factory: hidden_channels=hidden_nf, cutoff
+    per dataset, defaults num_interactions=6 / filters=128 / gaussians=50,
+    main.py:81 + SchNet.py:85-96). Returns (pos_pred, None)."""
+
+    hidden_channels: int = 128
+    num_filters: int = 128
+    num_interactions: int = 6
+    num_gaussians: int = 50
+    cutoff: float = 10.0
+    embed_input: bool = True
+
+    @nn.compact
+    def __call__(self, g: GraphBatch, h: Optional[jnp.ndarray] = None) -> Tuple[jnp.ndarray, None]:
+        pos = g.loc
+        if h is None:
+            h = g.node_feat
+        if self.embed_input:
+            h = nn.Dense(self.hidden_channels)(h)
+        pos, h = self.run_interactions(h, pos, g)
+        return pos, None
+
+    def run_interactions(self, h, pos, g: GraphBatch):
+        """The interaction stack, reusable by FastSchNet's coordinate path
+        (which feeds its own h and discards the feature update).
+
+        Distances and their gaussian expansion come from the INITIAL positions
+        only — the reference computes them once before the loop
+        (SchNet.py:187-189); just the direction vector tracks updated pos."""
+        N = pos.shape[1]
+        row, col = g.row, g.col
+        diff0 = gather_nodes(pos, row) - gather_nodes(pos, col)
+        edge_weight = jnp.linalg.norm(diff0 + 1e-30, axis=-1)
+        edge_attr = GaussianSmearing(0.0, self.cutoff, self.num_gaussians,
+                                     name="smearing")(edge_weight)
+        for i in range(self.num_interactions):
+            diff = gather_nodes(pos, row) - gather_nodes(pos, col)
+            # equivariant coordinate update (the reference's addition)
+            gate = nn.Dense(1, name=f"coord_update_{i}")(
+                jnp.concatenate([edge_attr, gather_nodes(h, row), gather_nodes(h, col)], axis=-1))
+            aggr = diff * gate
+            upd = jax.vmap(lambda m, r, e: segment_mean(m, r, N, mask=e))(aggr, row, g.edge_mask)
+            pos = pos + upd * g.node_mask[..., None]
+            h = h + InteractionBlock(self.hidden_channels, self.num_filters, self.cutoff,
+                                     name=f"interaction_{i}")(h, g, edge_weight, edge_attr)
+            h = h * g.node_mask[..., None]
+        return pos, h

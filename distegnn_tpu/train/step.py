@@ -28,6 +28,7 @@ import optax
 from flax import struct
 
 from distegnn_tpu.ops.graph import GraphBatch
+from distegnn_tpu.parallel.collectives import _psum
 from distegnn_tpu.train.loss import (
     masked_mse,
     mmd_loss,
@@ -99,7 +100,7 @@ def make_loss_fn(model, mmd_weight: float, mmd_sigma: float, mmd_samples: int,
         loc_pred, virtual_loc = model.apply(params, batch)
         mse_local = masked_mse(loc_pred, batch.target, batch.node_mask)
         loss = weighted_local_loss(mse_local, batch.node_mask, axis_name)
-        logged = _psum_scalar(loss, axis_name)
+        logged = _psum(loss, axis_name)
         if mmd_weight:
             if axis_name is not None:
                 # independent sample draw per partition (each rank samples its
@@ -110,10 +111,6 @@ def make_loss_fn(model, mmd_weight: float, mmd_sigma: float, mmd_samples: int,
         return loss, logged
 
     return loss_fn
-
-
-def _psum_scalar(x, axis_name):
-    return jax.lax.psum(x, axis_name) if axis_name is not None else x
 
 
 def make_train_step(model, tx: optax.GradientTransformation, mmd_weight: float,
@@ -133,7 +130,7 @@ def make_train_step(model, tx: optax.GradientTransformation, mmd_weight: float,
         updates, opt_state = tx.update(grads, state.opt_state, state.params)
         params = optax.apply_updates(state.params, updates)
         new_state = TrainState(params=params, opt_state=opt_state, step=state.step + 1)
-        return new_state, {"loss": logged, "loss_with_mmd": _psum_scalar(loss, axis_name)}
+        return new_state, {"loss": logged, "loss_with_mmd": _psum(loss, axis_name)}
 
     return step
 

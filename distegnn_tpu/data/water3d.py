@@ -1,0 +1,136 @@
+"""Water-3D pipeline (reference process_water3d_cutoff,
+datasets/process_dataset.py:225-297, and process_water_3d_dist, :308-438).
+
+Input: ``{split}.h5`` files (DeepMind learning_to_simulate trajectories
+converted by dataset_generation/Water-3D/tfrecord_to_h5.py) — per trajectory
+key: ``particle_type`` [N], ``position`` [T, N, 3]. Per trajectory, 15 random
+frames from the first 250 form (frame -> frame+delta_t) prediction pairs;
+velocity is the one-step difference. The reference draws frames with an
+UNSEEDED random.randint (process_dataset.py:241) — here the draw is seeded so
+shards and reruns are reproducible.
+
+Cutoff mode writes one pickle per split; distribute mode partitions every
+frame with the chosen split_mode and writes per-rank shard files (the
+reference's rank-0 flow)."""
+
+from __future__ import annotations
+
+import os
+import pickle
+import zlib
+from typing import List, Optional
+
+import numpy as np
+
+
+def _split_seed(seed: int, split: str) -> list:
+    """Deterministic RNG stream per (seed, split) — crc32, NOT Python's
+    per-process-salted hash()."""
+    return [seed, zlib.crc32(split.encode())]
+
+from distegnn_tpu.data.distribute import write_partitioned_split
+from distegnn_tpu.ops.radius import cutoff_edges_np, radius_graph_np
+
+FRAME_RANGE = 250   # reference: "15 random frames from former 250"
+FRAMES_PER_TRAJ = 15
+
+
+def build_water3d_graph(loc_0, vel_0, particle_type, target, radius: float,
+                        cutoff_rate: float = 0.0, with_edges: bool = True) -> dict:
+    """node_feat = [|v|, type/max type]; node_attr = type; distance edge_attr
+    (reference process_dataset.py:258-277)."""
+    loc_0 = np.asarray(loc_0, np.float32)
+    vel_0 = np.asarray(vel_0, np.float32)
+    ptype = np.asarray(particle_type, np.float32).reshape(-1, 1)
+
+    if with_edges:
+        edge_index = radius_graph_np(loc_0, radius)
+        edge_index = cutoff_edges_np(edge_index, loc_0, cutoff_rate)
+    else:
+        edge_index = np.zeros((2, 0), np.int64)
+    dist = np.linalg.norm(loc_0[edge_index[0]] - loc_0[edge_index[1]], axis=1)
+
+    speed = np.linalg.norm(vel_0, axis=1, keepdims=True)
+    node_feat = np.concatenate([speed, ptype / max(ptype.max(), 1e-12)], axis=1)
+    return {
+        "node_feat": node_feat.astype(np.float32),
+        "node_attr": ptype,
+        "loc": loc_0,
+        "vel": vel_0,
+        "target": np.asarray(target, np.float32),
+        "loc_mean": loc_0.mean(axis=0),
+        "edge_index": edge_index.astype(np.int32),
+        "edge_attr": np.repeat(dist[:, None], 2, axis=1).astype(np.float32),
+    }
+
+
+def _iter_frames(h5file, max_samples: int, delta_t: int, rng: np.random.Generator):
+    """Yield (loc_0, vel_0, particle_type, target) tuples, <= max_samples."""
+    import h5py  # C-backed IO; fine on TPU hosts (SURVEY.md §2.9)
+
+    count = 0
+    with h5py.File(h5file, "r") as f:
+        for key in sorted(f.keys()):
+            if count >= max_samples:
+                break
+            ptype = np.asarray(f[key]["particle_type"])
+            pos = np.asarray(f[key]["position"])
+            n = min(FRAMES_PER_TRAJ, max_samples - count)
+            hi = min(FRAME_RANGE, pos.shape[0] - delta_t - 1)
+            for frame in rng.integers(0, max(hi, 1), size=n):
+                yield (pos[frame], pos[frame + 1] - pos[frame], ptype, pos[frame + delta_t])
+                count += 1
+
+
+def process_water3d_cutoff(data_dir: str, dataset_name: str, max_samples: int,
+                           radius: float, delta_t: int, cutoff_rate: float,
+                           seed: int = 0) -> List[str]:
+    base = os.path.join(data_dir, dataset_name)
+    processed_dir = os.path.join(base, "processed")
+    os.makedirs(processed_dir, exist_ok=True)
+    paths = []
+    for split in ("train", "valid", "test"):
+        out = os.path.join(
+            processed_dir,
+            f"{dataset_name}_{split}_{radius}_{cutoff_rate:.3f}_{max_samples}_{delta_t}_s{seed}.pkl")
+        paths.append(out)
+        if os.path.exists(out):
+            continue
+        rng = np.random.default_rng(_split_seed(seed, split))
+        graphs = [
+            build_water3d_graph(l, v, p, t, radius, cutoff_rate)
+            for l, v, p, t in _iter_frames(os.path.join(base, f"{split}.h5"),
+                                           max_samples, delta_t, rng)
+        ]
+        with open(out, "wb") as f:
+            pickle.dump(graphs, f, protocol=pickle.HIGHEST_PROTOCOL)
+    return paths
+
+
+def process_water3d_distribute(data_dir: str, dataset_name: str, world_size: int,
+                               max_samples: int, inner_radius: float,
+                               outer_radius: Optional[float], split_mode: str,
+                               delta_t: int, seed: int = 0) -> List[List[str]]:
+    """Distribute mode (reference process_water_3d_dist): every frame is
+    partitioned into world_size shards; returns per-split lists of per-rank
+    paths."""
+    base = os.path.join(data_dir, dataset_name)
+    processed_dir = os.path.join(base, "processed")
+    os.makedirs(processed_dir, exist_ok=True)
+    out = []
+    for split in ("train", "valid", "test"):
+        key = (f"{dataset_name}_{split_mode}_{split}_o{outer_radius}_i{inner_radius}"
+               f"_{max_samples}_{delta_t}_s{seed}")
+        rng = np.random.default_rng(_split_seed(seed, split))
+        shard_paths = [os.path.join(processed_dir, f"{key}_{p}-{world_size}.pkl")
+                       for p in range(world_size)]
+        if not all(os.path.exists(p) for p in shard_paths):
+            graphs = [
+                build_water3d_graph(l, v, p, t, inner_radius, with_edges=False)
+                for l, v, p, t in _iter_frames(os.path.join(base, f"{split}.h5"),
+                                               max_samples, delta_t, rng)
+            ]
+            write_partitioned_split(graphs, processed_dir, key, world_size,
+                                    split_mode, inner_radius, outer_radius, seed=seed)
+        out.append(shard_paths)
+    return out

@@ -174,8 +174,9 @@ class EGNN(nn.Module):
 class RFVel(nn.Module):
     """RF baseline (reference RF_vel + GCL_rf_vel, basic.py:413-464): per
     layer m_ij = (x_i - x_j) * tanh(phi(|x_i - x_j|, e_ij)) with the bias-free
-    xavier(0.001) scalar head, x += mean-agg + v * psi(|v|).
-    Returns (loc_pred, None)."""
+    xavier(0.001) scalar head, x += mean-agg + v * psi(|v|). Activation is
+    SiLU — RF_vel forwards its act_fn default into the layers (basic.py:419),
+    unlike FastRF which drops it. Returns (loc_pred, None)."""
 
     hidden_nf: int
     edge_attr_nf: int = 0
@@ -192,20 +193,20 @@ class RFVel(nn.Module):
             radial = jnp.sqrt(jnp.sum(x_diff**2, axis=-1, keepdims=True) + 1e-30)
             e_in = (jnp.concatenate([radial, g.edge_attr], axis=-1)
                     if self.edge_attr_nf else radial)
-            gate = MLP([self.hidden_nf, 1], act=_leaky, use_bias_last=False,
+            gate = MLP([self.hidden_nf, 1], use_bias_last=False,
                        kernel_init_last=coord_head_init, name=f"phi_{i}")(e_in)
             m = x_diff * jnp.tanh(gate)
             agg = jax.vmap(lambda mm, r, e: segment_mean(mm, r, N, mask=e))(m, row, g.edge_mask)
             x = x + agg
-            x = x + v * MLP([self.hidden_nf, 1], act=_leaky, name=f"coord_mlp_vel_{i}")(vel_norm)
+            x = x + v * MLP([self.hidden_nf, 1], name=f"coord_mlp_vel_{i}")(vel_norm)
             x = x * g.node_mask[..., None]
         return x, None
 
 
 class GNN(nn.Module):
     """Plain message-passing GNN with a 3-dim decoder (reference GNN_Layer +
-    GNN, basic.py:359-399): non-equivariant baseline; the decoder output is
-    added to input positions."""
+    GNN, basic.py:359-399): non-equivariant baseline predicting absolute
+    positions (decoder output returned directly)."""
 
     n_layers: int
     in_node_nf: int
@@ -222,15 +223,15 @@ class GNN(nn.Module):
             msg_in = [gather_nodes(h, row), gather_nodes(h, col)]
             if self.in_edge_nf:
                 msg_in.append(g.edge_attr)
-            msg = MLP([self.hidden_nf, self.hidden_nf], act_last=True,
+            msg = MLP([self.hidden_nf, self.hidden_nf],
                       name=f"edge_mlp_{i}")(jnp.concatenate(msg_in, axis=-1))
             msg = msg * g.edge_mask[..., None]
             agg = jax.vmap(lambda m, r, e: segment_mean(m, r, N, mask=e))(msg, row, g.edge_mask)
             h = h + MLP([self.hidden_nf, self.hidden_nf],
-                        name=f"node_mlp_{i}")(jnp.concatenate([h, agg], axis=-1))
+                        name=f"node_mlp_{i}")(jnp.concatenate([agg, h], axis=-1))
             h = h * g.node_mask[..., None]
         out = MLP([self.hidden_nf, 3], name="decoder")(h)
-        return g.loc + out * g.node_mask[..., None], None
+        return out * g.node_mask[..., None], None
 
 
 class LinearDynamics(nn.Module):

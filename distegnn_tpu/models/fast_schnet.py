@@ -58,12 +58,12 @@ class SchNetGCLVel(nn.Module):
         nm = node_mask[..., None]
         B, N = h.shape[0], h.shape[1]
 
+        # normalize is accepted for config parity but is a no-op here AS IN THE
+        # REFERENCE: its coord2radial normalizes coord_diff, which FastSchNet
+        # then never consumes (only radial and the SchNet sublayer's raw
+        # positions are used, FastSchNet.py:169-186)
         raw_diff = gather_nodes(x, row) - gather_nodes(x, col)
         radial = jnp.sum(raw_diff**2, axis=-1, keepdims=True)
-        coord_diff = raw_diff
-        if self.normalize:
-            norm = jax.lax.stop_gradient(jnp.sqrt(radial)) + self.epsilon
-            coord_diff = raw_diff / norm
         vcd = X[:, None, :, :] - x[..., None]                            # [B, N, 3, C]
         virtual_radial = jnp.linalg.norm(vcd, axis=2, keepdims=True)
 
@@ -101,7 +101,7 @@ class SchNetGCLVel(nn.Module):
         # SchNet sublayer always works on bare positions
         edge_weight = jnp.linalg.norm(raw_diff + 1e-30, axis=-1)
         gauss = GaussianSmearing(0.0, self.cutoff, self.num_gaussians, name="smearing")(edge_weight)
-        gate = nn.Dense(1, name="schnet_coord_update")(
+        gate = TorchDense(1, name="schnet_coord_update")(
             jnp.concatenate([gauss, gather_nodes(h, row), gather_nodes(h, col)], axis=-1))
         agg = jax.vmap(lambda m, r, e: segment_mean(m, r, N, mask=e))(
             raw_diff * gate, row, edge_mask)

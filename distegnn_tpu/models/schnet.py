@@ -21,7 +21,7 @@ import jax.numpy as jnp
 import numpy as np
 from flax import linen as nn
 
-from distegnn_tpu.models.common import gather_nodes
+from distegnn_tpu.models.common import TorchDense, gather_nodes
 from distegnn_tpu.ops.graph import GraphBatch
 from distegnn_tpu.ops.segment import segment_mean, segment_sum
 
@@ -101,7 +101,9 @@ class SchNet(nn.Module):
         if h is None:
             h = g.node_feat
         if self.embed_input:
-            h = nn.Dense(self.hidden_channels)(h)
+            # torch-default init: the reference does not re-init its embedding
+            # Linear (SchNet.py:121-124 is excluded from reset_parameters)
+            h = TorchDense(self.hidden_channels, name="embedding")(h)
         pos, h = self.run_interactions(h, pos, g)
         return pos, None
 
@@ -120,8 +122,9 @@ class SchNet(nn.Module):
                                      name="smearing")(edge_weight)
         for i in range(self.num_interactions):
             diff = gather_nodes(pos, row) - gather_nodes(pos, col)
-            # equivariant coordinate update (the reference's addition)
-            gate = nn.Dense(1, name=f"coord_update_{i}")(
+            # equivariant coordinate update (the reference's addition; its
+            # coord_updates Linears keep torch default init, SchNet.py:137-139)
+            gate = TorchDense(1, name=f"coord_update_{i}")(
                 jnp.concatenate([edge_attr, gather_nodes(h, row), gather_nodes(h, col)], axis=-1))
             aggr = diff * gate
             upd = jax.vmap(lambda m, r, e: segment_mean(m, r, N, mask=e))(aggr, row, g.edge_mask)

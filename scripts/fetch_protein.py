@@ -1,0 +1,28 @@
+"""Fetch the AdK equilibrium trajectory and cache it as npz (reference
+dataset_generation/protein/mdanalysis.py + stage 1 of the protein pipeline).
+
+Requires MDAnalysis/MDAnalysisData (not in the TPU image — run wherever they
+are installed; the npz is what the training pipeline consumes).
+
+Usage:
+  python scripts/fetch_protein.py --data-dir data/protein [--no-backbone]
+"""
+
+from __future__ import annotations
+
+import argparse
+
+from distegnn_tpu.data.protein import extract_adk_npz
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--data-dir", type=str, default="data/protein")
+    p.add_argument("--no-backbone", action="store_true")
+    args = p.parse_args()
+    out = extract_adk_npz(args.data_dir, backbone=not args.no_backbone)
+    print(f"Cached: {out}")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,79 @@
+"""Convert DeepMind learning_to_simulate Water-3D tfrecords to the h5 layout
+the Water-3D pipeline reads (reference dataset_generation/Water-3D/
+tfrecord_to_h5.py — which depends on DeepMind's reading_utils; this version
+parses the tf.SequenceExample format directly and is otherwise equivalent:
+one h5 group per trajectory with `particle_type` [N] and `position` [T, N, 3]).
+
+Requires tensorflow (read-only use). Usage:
+  python scripts/water3d_tfrecord_to_h5.py --dataset-path data/simulate/Water-3D
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+
+import h5py
+import numpy as np
+
+
+def convert(dataset_path: str, file_name: str, dim: int = 3) -> str:
+    import tensorflow as tf
+
+    path = os.path.join(dataset_path, file_name)
+    print(f"Converting {path} -> h5")
+    out_path = path[:-len(".tfrecord")] + ".h5"
+
+    context_desc = {
+        "key": tf.io.FixedLenFeature([], tf.int64, default_value=0),
+        "particle_type": tf.io.VarLenFeature(tf.string),
+    }
+    seq_desc = {"position": tf.io.VarLenFeature(tf.string)}
+
+    with h5py.File(out_path, "w") as hf:
+        for i, record in enumerate(tf.data.TFRecordDataset([path])):
+            context, seq = tf.io.parse_single_sequence_example(
+                record, context_features=context_desc, sequence_features=seq_desc)
+            ptype = np.frombuffer(
+                tf.sparse.to_dense(context["particle_type"]).numpy()[0], dtype=np.int64)
+            pos_bytes = tf.sparse.to_dense(seq["position"]).numpy()
+            position = np.stack([
+                np.frombuffer(b[0], dtype=np.float32).reshape(-1, dim) for b in pos_bytes
+            ])
+            traj = str(i).zfill(5)
+            hf.create_dataset(f"{traj}/particle_type", data=ptype)
+            hf.create_dataset(f"{traj}/position", data=position,
+                              dtype=np.float32, compression="gzip")
+    print(f"Wrote {out_path}")
+    return out_path
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--dataset-path", type=str, required=True)
+    args = parser.parse_args()
+
+    files = [f for f in os.listdir(args.dataset_path) if f.endswith(".tfrecord")]
+    for f in files:
+        convert(args.dataset_path, f)
+
+    # record num_particles_max in metadata.json (reference does the same)
+    meta_path = os.path.join(args.dataset_path, "metadata.json")
+    if os.path.exists(meta_path):
+        with open(meta_path) as fp:
+            metadata = json.load(fp)
+        max_particles = 0
+        for f in os.listdir(args.dataset_path):
+            if f.endswith(".h5"):
+                with h5py.File(os.path.join(args.dataset_path, f), "r") as hf:
+                    for v in hf.values():
+                        max_particles = max(int(v["particle_type"].shape[0]), max_particles)
+        metadata["num_particles_max"] = max_particles
+        metadata["periodic_boundary_conditions"] = [False, False, False]
+        with open(meta_path, "w") as fp:
+            json.dump(metadata, fp)
+
+
+if __name__ == "__main__":
+    main()

@@ -17,7 +17,6 @@ isn't in this image): {b'nd': True, b'type': .., b'shape': .., b'data': ..}
 from __future__ import annotations
 
 import os
-import pickle
 from typing import List, Optional
 
 import numpy as np
@@ -112,7 +111,9 @@ def process_large_fluid_distribute(data_dir: str, dataset_name: str, world_size:
             pos, vel, viscosity, mass = read_sim(data_dir, dataset_name, idx)
             n = min(FRAMES_PER_SIM, max_samples - len(graphs))
             hi_f = min(FRAME_RANGE, pos.shape[0] - delta_t - 1)
-            for frame in rng.integers(0, max(hi_f, 1), size=n):
+            if hi_f <= 0:
+                continue  # simulation too short for this delta_t
+            for frame in rng.integers(0, hi_f, size=n):
                 graphs.append(build_fluid_graph(pos[frame], vel[frame], viscosity,
                                                 mass, pos[frame + delta_t]))
         write_partitioned_split(graphs, processed_dir, key, world_size,

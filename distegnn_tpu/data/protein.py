@@ -51,7 +51,13 @@ def extract_adk_npz(data_dir: str, backbone: bool = True) -> str:
     charges = np.asarray(u.atoms[ag.ix].charges, np.float32)
     positions = np.stack([ts.positions[ag.ix].copy() for ts in u.trajectory]
                          ).astype(np.float32)
-    np.savez_compressed(out, positions=positions, charges=charges)
+    # box dimensions scale the test_trans injection (reference
+    # process_dataset.py:173 uses ts.dimensions[:3] / 2)
+    dims = np.asarray(u.dimensions[:3], np.float32) if u.dimensions is not None else None
+    if dims is not None:
+        np.savez_compressed(out, positions=positions, charges=charges, dimensions=dims)
+    else:
+        np.savez_compressed(out, positions=positions, charges=charges)
     return out
 
 
@@ -89,6 +95,11 @@ def process_protein_cutoff(data_dir: str, dataset_name: str, max_samples: int,
         npz_path = extract_adk_npz(base, backbone=backbone)
     data = np.load(npz_path)
     positions, charges = data["positions"], data["charges"]
+    # translation scale: box dimensions when the npz carries them (reference
+    # semantics), else the coordinate span as a fallback for bare npz caches
+    trans_scale = (np.asarray(data["dimensions"], np.float32)
+                   if "dimensions" in data.files
+                   else np.abs(positions).max(axis=(0, 1)))
     rng = np.random.default_rng(seed)
 
     paths = []
@@ -96,13 +107,12 @@ def process_protein_cutoff(data_dir: str, dataset_name: str, max_samples: int,
         out = os.path.join(
             processed_dir,
             f"{dataset_name}_{split}_{radius}_{cutoff_rate:.3f}_{max_samples}_{delta_t}"
-            f"_rot{int(test_rot)}_trans{int(test_trans)}.pkl")
+            f"_rot{int(test_rot)}_trans{int(test_trans)}_s{seed}.pkl")
         paths.append(out)
         if os.path.exists(out):
             continue
         hi = min(hi, positions.shape[0] - delta_t - 1, lo + max_samples)
         graphs = []
-        span = np.abs(positions).max(axis=(0, 1)) if test_trans else None
         for t in range(lo, hi):
             loc_0 = positions[t]
             vel_0 = positions[t + 1] - loc_0
@@ -111,7 +121,7 @@ def process_protein_cutoff(data_dir: str, dataset_name: str, max_samples: int,
                 R = random_rotate(rng).astype(np.float32)
                 loc_0, vel_0, target = loc_0 @ R, vel_0 @ R, target @ R
             if split == "test" and test_trans:
-                tr = (rng.standard_normal(3) * span / 2).astype(np.float32)
+                tr = (rng.standard_normal(3) * trans_scale / 2).astype(np.float32)
                 loc_0, target = loc_0 + tr, target + tr
             graphs.append(build_protein_graph(loc_0, vel_0, charges, target,
                                               radius, cutoff_rate))

@@ -77,7 +77,9 @@ def _iter_frames(h5file, max_samples: int, delta_t: int, rng: np.random.Generato
             pos = np.asarray(f[key]["position"])
             n = min(FRAMES_PER_TRAJ, max_samples - count)
             hi = min(FRAME_RANGE, pos.shape[0] - delta_t - 1)
-            for frame in rng.integers(0, max(hi, 1), size=n):
+            if hi <= 0:
+                continue  # trajectory too short for this delta_t
+            for frame in rng.integers(0, hi, size=n):
                 yield (pos[frame], pos[frame + 1] - pos[frame], ptype, pos[frame + delta_t])
                 count += 1
 

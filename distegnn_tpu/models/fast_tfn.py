@@ -1,0 +1,159 @@
+"""FastTFN — the FastEGNN virtual-node skeleton whose real-node coordinate
+update is a 1-layer TFN, TPU-native.
+
+Re-design of reference models/FastTFN.py (TFN_GCL_vel + FastTFN, 281 LoC): per
+layer the real coordinates move by a tiny TFN (num_layers=1, num_channels=1,
+num_degrees=2 — FastTFN.py:37) fed with charges (degree 0) and velocity
+(degree 1) over the same edges, while the virtual-node machinery is exactly
+FastEGNN's. The reference builds a DGL graph per forward (FastTFN.py:129-141);
+here the TFN runs on the same padded GraphBatch arrays. Single-device model in
+the reference (no dist code, SURVEY.md §2.4); axis_name generalizes it to the
+mesh anyway."""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from flax import linen as nn
+
+from distegnn_tpu.models.common import MLP, CoordMLP, TorchDense, gather_nodes
+from distegnn_tpu.models.se3.basis import cart_to_deg1, deg1_to_cart
+from distegnn_tpu.models.se3.tfn import TFN
+from distegnn_tpu.ops.graph import GraphBatch
+from distegnn_tpu.ops.segment import segment_mean
+from distegnn_tpu.parallel.collectives import global_node_mean
+
+
+class TFNGCLVel(nn.Module):
+    """One FastTFN layer (reference TFN_GCL_vel, FastTFN.py:9-204)."""
+
+    hidden_nf: int
+    virtual_channels: int
+    node_attr_nf: int = 0
+    edge_attr_nf: int = 0
+    residual: bool = True
+    attention: bool = False
+    normalize: bool = False
+    tanh: bool = False
+    has_gravity: bool = False
+    axis_name: Optional[str] = None
+    epsilon: float = 1e-8
+
+    @nn.compact
+    def __call__(self, h, x, v, X, Hv, g: GraphBatch, charges, gravity=None):
+        H, C = self.hidden_nf, self.virtual_channels
+        row, col = g.row, g.col
+        node_mask, edge_mask = g.node_mask, g.edge_mask
+        nm = node_mask[..., None]
+        B, N = h.shape[0], h.shape[1]
+
+        raw_diff = gather_nodes(x, row) - gather_nodes(x, col)
+        radial = jnp.sum(raw_diff**2, axis=-1, keepdims=True)
+        vcd = X[:, None, :, :] - x[..., None]
+        virtual_radial = jnp.linalg.norm(vcd, axis=2, keepdims=True)
+
+        e_in = [gather_nodes(h, row), gather_nodes(h, col), radial]
+        if self.edge_attr_nf:
+            e_in.append(g.edge_attr)
+        edge_feat = MLP([H, H], act_last=True, name="phi_e")(jnp.concatenate(e_in, axis=-1))
+        if self.attention:
+            edge_feat = edge_feat * jax.nn.sigmoid(TorchDense(1, name="att")(edge_feat))
+        edge_feat = edge_feat * edge_mask[..., None]
+
+        coord_mean = global_node_mean(x, node_mask, axis_name=None)   # LOCAL (single-device model)
+        Xc = X - coord_mean[:, :, None]
+        m_X = jnp.einsum("bdc,bde->bce", Xc, Xc)
+
+        v_in = jnp.concatenate(
+            [
+                jnp.broadcast_to(h[:, :, None, :], (B, N, C, H)),
+                jnp.broadcast_to(jnp.swapaxes(Hv, 1, 2)[:, None, :, :], (B, N, C, H)),
+                jnp.swapaxes(virtual_radial, 2, 3),
+                jnp.broadcast_to(m_X[:, None, :, :], (B, N, C, C)),
+            ],
+            axis=-1,
+        )
+        vef = MLP([H, H], act_last=True, name="phi_ev")(v_in)
+        if self.attention:
+            vef = vef * jax.nn.sigmoid(TorchDense(1, name="att_v")(vef))
+        vef = vef * node_mask[:, :, None, None]
+
+        # real coordinate update by a 1-layer TFN over the same graph, on a
+        # GraphBatch whose loc is the CURRENT x (coord_model_by_tfn,
+        # FastTFN.py:129-150): in {charges:0, vel:1} -> out {1:1}
+        g_now = g.replace(loc=x)
+        tfn_in = {0: charges[..., None], 1: cart_to_deg1(v)[:, :, None, :]}
+        tfn_out = TFN(num_layers=1, num_channels=1, num_degrees=2,
+                      in_types={0: 1, 1: 1}, out_types={1: 1}, name="tfn_layer")(tfn_in, g_now)
+        x = x + deg1_to_cart(tfn_out[1][:, :, 0, :])
+
+        phi_xv = CoordMLP(H, tanh=self.tanh, name="phi_xv")(vef)
+        x = x + jnp.mean(-vcd * jnp.swapaxes(phi_xv, 2, 3), axis=-1)
+        if self.has_gravity:
+            x = x + MLP([H, 1], name="phi_g")(h) * gravity
+        x = x * nm
+
+        trans_X = vcd * jnp.swapaxes(CoordMLP(H, tanh=self.tanh, name="phi_X")(vef), 2, 3)
+        X = X + global_node_mean(trans_X, node_mask, self.axis_name)
+
+        agg_h = jax.vmap(lambda t, r, m: segment_mean(t, r, N, mask=m))(edge_feat, row, edge_mask)
+        agg_v = jnp.mean(vef, axis=2)
+        n_in = [h, agg_h, agg_v]
+        if self.node_attr_nf:
+            n_in.append(g.node_attr)
+        out = MLP([H, H], name="phi_h")(jnp.concatenate(n_in, axis=-1))
+        h = ((h + out) if self.residual else out) * nm
+
+        agg_Hv = global_node_mean(vef, node_mask, self.axis_name)
+        hv_in = jnp.concatenate([jnp.swapaxes(Hv, 1, 2), agg_Hv], axis=-1)
+        out_v = jnp.swapaxes(MLP([H, H], name="phi_hv")(hv_in), 1, 2)
+        Hv = (Hv + out_v) if self.residual else out_v
+
+        return h, x, Hv, X
+
+
+class FastTFN(nn.Module):
+    """FastTFN wrapper (reference FastTFN.py:207-260). Forward takes the extra
+    ``charges`` from node_attr (reference model_forward passes charges,
+    utils/train.py:67-70)."""
+
+    node_feat_nf: int
+    node_attr_nf: int = 0
+    edge_attr_nf: int = 0
+    hidden_nf: int = 64
+    virtual_channels: int = 3
+    n_layers: int = 4
+    residual: bool = True
+    attention: bool = False
+    normalize: bool = False
+    tanh: bool = False
+    gravity: Optional[Tuple[float, float, float]] = None
+    axis_name: Optional[str] = None
+
+    @nn.compact
+    def __call__(self, g: GraphBatch) -> Tuple[jnp.ndarray, jnp.ndarray]:
+        assert self.virtual_channels > 0, "virtual_channels must be > 0"
+        B = g.batch_size
+        H, C = self.hidden_nf, self.virtual_channels
+
+        charges = g.node_attr[..., 0] if g.node_attr.shape[-1] else g.node_feat[..., -1]
+        Hv0 = self.param("virtual_node_feat", nn.initializers.normal(1.0), (1, H, C))
+        Hv = jnp.broadcast_to(Hv0, (B, H, C))
+        X = jnp.repeat(g.loc_mean[:, :, None], C, axis=2)
+
+        h = TorchDense(H, name="embedding_in")(g.node_feat)
+        x, v = g.loc, g.vel
+        gravity = jnp.asarray(self.gravity, jnp.float32) if self.gravity is not None else None
+
+        for i in range(self.n_layers):
+            h, x, Hv, X = TFNGCLVel(
+                hidden_nf=H, virtual_channels=C,
+                node_attr_nf=self.node_attr_nf, edge_attr_nf=self.edge_attr_nf,
+                residual=self.residual, attention=self.attention,
+                normalize=self.normalize, tanh=self.tanh,
+                has_gravity=self.gravity is not None, axis_name=self.axis_name,
+                name=f"gcl_{i}",
+            )(h, x, v, X, Hv, g, charges, gravity=gravity)
+        return x, X

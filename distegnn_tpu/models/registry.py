@@ -95,6 +95,12 @@ def get_model(model_config, world_size: int = 1, dataset_name: Optional[str] = N
         TFNDynamics = _import_model("se3.dynamics", "TFNDynamics")
         return TFNDynamics(nf=model_config.hidden_nf // 2, n_layers=model_config.n_layers,
                            num_degrees=2)
+    if name == "SE3Transformer":
+        # capability extension: the reference assembles OurSE3Transformer
+        # (models.py:207) but never serves it from its factory
+        SE3TransformerDynamics = _import_model("se3.dynamics", "SE3TransformerDynamics")
+        return SE3TransformerDynamics(nf=model_config.hidden_nf // 2,
+                                      n_layers=model_config.n_layers, num_degrees=2)
     if name == "FastTFN":
         FastTFN = _import_model("fast_tfn", "FastTFN")
         return FastTFN(

@@ -1,0 +1,22 @@
+"""SE(3)-equivariant stack (TFN / SE(3)-Transformer), TPU-native.
+
+Replaces the reference's vendored Fuchs et al. code
+(models/se3_dynamics/**, ~1.8K LoC on DGL + lie_learn): spherical-harmonic /
+Wigner math lives in so3.py (host numpy, float64), the runtime basis is
+closed-form jnp (basis.py), and the conv/attention layers are einsums over
+padded edge arrays (tfn.py) — no graph library, MXU-shaped contractions.
+"""
+
+from distegnn_tpu.models.se3.fibers import Fiber
+from distegnn_tpu.models.se3.tfn import GConvSE3, GNormSE3, G1x1SE3, TFN
+from distegnn_tpu.models.se3.attention import (
+    GConvSE3Partial,
+    GMABSE3,
+    GSE3Res,
+    SE3Transformer,
+)
+from distegnn_tpu.models.se3.dynamics import SE3TransformerDynamics, TFNDynamics
+
+__all__ = ["Fiber", "GConvSE3", "GNormSE3", "G1x1SE3", "TFN", "TFNDynamics",
+           "GConvSE3Partial", "GMABSE3", "GSE3Res", "SE3Transformer",
+           "SE3TransformerDynamics"]

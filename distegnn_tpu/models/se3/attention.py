@@ -1,0 +1,185 @@
+"""SE(3)-equivariant attention (SE(3)-Transformer) — TPU-native.
+
+Re-design of reference equivariant_attention/modules.py attention half:
+GConvSE3Partial (per-edge kernel values, :386-470), GMABSE3 (multi-head
+attention with edge_softmax, :473-552), GSE3Res (attention block, :555-608),
+GSum/GCat (:614-685), GAvgPooling/GMaxPooling (:688-716), and the
+OurSE3Transformer assembly with its scalar_trick output scaling
+(models.py:207-295). DGL's edge_softmax becomes a masked segment softmax
+(ops/segment.segment_softmax)."""
+
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from flax import linen as nn
+
+from distegnn_tpu.models.common import gather_nodes
+from distegnn_tpu.models.se3.basis import compute_basis_and_r
+from distegnn_tpu.models.se3.fibers import Fiber
+from distegnn_tpu.models.se3.tfn import G1x1SE3, GConvSE3, GNormSE3, RadialFunc
+from distegnn_tpu.ops.graph import GraphBatch
+from distegnn_tpu.ops.segment import segment_softmax, segment_sum
+
+
+class GConvSE3Partial(nn.Module):
+    """Node -> edge partial conv: per-edge kernel application WITHOUT the
+    aggregation (value/key embeddings for attention)."""
+
+    f_in: Fiber
+    f_out: Fiber
+    edge_dim: int = 0
+
+    @nn.compact
+    def __call__(self, h: Dict[int, jnp.ndarray], g: GraphBatch, r, basis):
+        N = g.loc.shape[1]
+        col = g.col
+        feat = jnp.concatenate([g.edge_attr, r], axis=-1) if self.edge_dim else r
+        out = {}
+        for m_out, d_out in self.f_out.structure:
+            msg = 0.0
+            for m_in, d_in in self.f_in.structure:
+                R = RadialFunc(2 * min(d_in, d_out) + 1, m_in, m_out,
+                               name=f"radial_{d_in}_{d_out}")(feat)
+                src = gather_nodes(h[d_in].reshape(h[d_in].shape[0], N, -1), col)
+                src = src.reshape(src.shape[:2] + (m_in, 2 * d_in + 1))
+                msg = msg + jnp.einsum("beoif,bepqf,beiq->beop",
+                                       R, basis[(d_in, d_out)], src)
+            out[d_out] = msg                                # [B, E, m_out, 2d_out+1]
+        return out
+
+
+def fiber2head(F: Dict[int, jnp.ndarray], n_heads: int, structure: Fiber) -> jnp.ndarray:
+    """Stack a fiber dict into per-head flat vectors [..., heads, feat]
+    (reference fibers.py:145-152)."""
+    parts = [F[d].reshape(F[d].shape[:-2] + (n_heads, -1)) for d in structure.degrees]
+    return jnp.concatenate(parts, axis=-1)
+
+
+class GMABSE3(nn.Module):
+    """Multi-head attention: score = <k_edge, q_dst>/sqrt(F); masked softmax
+    over each node's incoming edges; attention-weighted value sum."""
+
+    f_value: Fiber
+    f_key: Fiber
+    n_heads: int = 1
+
+    @nn.compact
+    def __call__(self, v: Dict, k: Dict, q: Dict, g: GraphBatch):
+        N = g.loc.shape[1]
+        row = g.row
+        k_h = fiber2head(k, self.n_heads, self.f_key)                   # [B, E, H, F]
+        q_h = fiber2head(q, self.n_heads, self.f_key)                   # [B, N, H, F]
+        q_edge = gather_nodes(q_h.reshape(q_h.shape[0], N, -1), row)
+        q_edge = q_edge.reshape(k_h.shape)
+        scores = jnp.sum(k_h * q_edge, axis=-1) / np.sqrt(self.f_key.n_features)  # [B, E, H]
+        attn = jax.vmap(lambda s, rr, m: segment_softmax(s, rr, N, mask=m))(
+            scores, row, g.edge_mask)                                   # [B, E, H]
+
+        out = {}
+        for m, d in self.f_value.structure:
+            val = v[d].reshape(v[d].shape[:2] + (self.n_heads, m // self.n_heads, 2 * d + 1))
+            weighted = attn[..., None, None] * val
+            flat = weighted.reshape(weighted.shape[:2] + (-1,))
+            agg = jax.vmap(lambda t, rr, e: segment_sum(t, rr, N, mask=e))(flat, row, g.edge_mask)
+            out[d] = agg.reshape(agg.shape[:2] + (m, 2 * d + 1))
+        return out
+
+
+class GSE3Res(nn.Module):
+    """Attention block: value/key partial convs + query projection + GMABSE3
+    (reference GSE3Res; its skip connection is commented out upstream and
+    likewise omitted here)."""
+
+    f_in: Fiber
+    f_out: Fiber
+    edge_dim: int = 0
+    div: float = 1
+    n_heads: int = 1
+
+    @nn.compact
+    def __call__(self, h: Dict, g: GraphBatch, r, basis):
+        f_mid_out = Fiber(dictionary={d: int(m // self.div)
+                                      for d, m in self.f_out.structure_dict.items()})
+        f_mid_in = Fiber(dictionary={d: m for d, m in f_mid_out.structure_dict.items()
+                                     if d in self.f_in.structure_dict})
+        v = GConvSE3Partial(self.f_in, f_mid_out, edge_dim=self.edge_dim, name="v")(h, g, r, basis)
+        k = GConvSE3Partial(self.f_in, f_mid_in, edge_dim=self.edge_dim, name="k")(h, g, r, basis)
+        q = G1x1SE3(self.f_in, f_mid_in, name="q")(h)
+        return GMABSE3(f_mid_out, f_mid_in, n_heads=self.n_heads, name="attn")(v, k, q, g)
+
+
+def gsum(x: Dict, y: Dict) -> Dict:
+    """Residual sum with zero-padding of mismatched multiplicities
+    (reference GSum, modules.py:645-680)."""
+    out = {}
+    for d in set(x) | set(y):
+        if d in x and d in y:
+            a, b = x[d], y[d]
+            if a.shape[-2] != b.shape[-2]:
+                m = max(a.shape[-2], b.shape[-2])
+                pad = lambda t: jnp.pad(t, [(0, 0)] * (t.ndim - 2)
+                                        + [(0, m - t.shape[-2]), (0, 0)])
+                a, b = pad(a), pad(b)
+            out[d] = a + b
+        else:
+            out[d] = x.get(d, y.get(d))
+    return out
+
+
+def gcat(x: Dict, y: Dict) -> Dict:
+    """Concat multiplicities for degrees present in x (reference GCat)."""
+    return {d: (jnp.concatenate([x[d], y[d]], axis=-2) if d in y else x[d]) for d in x}
+
+
+def g_avg_pool(features: Dict, g: GraphBatch, degree: int = 0) -> jnp.ndarray:
+    """Masked mean over nodes (reference GAvgPooling)."""
+    h = features[degree]
+    m = g.node_mask[..., None, None]
+    return jnp.sum(h * m, axis=1) / jnp.maximum(jnp.sum(m, axis=1), 1.0)
+
+
+def g_max_pool(features: Dict, g: GraphBatch) -> jnp.ndarray:
+    """Masked max over nodes of the last degree-0 channel (reference GMaxPooling)."""
+    h = features[0][..., -1]
+    mask = g.node_mask[:, :, None].astype(bool)
+    return jnp.max(jnp.where(mask, h, -1e30), axis=1)
+
+
+class SE3Transformer(nn.Module):
+    """OurSE3Transformer assembly (reference models.py:207-295): num_layers x
+    [GSE3Res -> GNormSE3], final GConvSE3 to the out fiber, every output
+    degree scaled by the learnable scalar_trick (init 0.01, models.py:234,293)."""
+
+    num_layers: int
+    num_channels: int
+    num_degrees: int = 4
+    edge_dim: int = 0
+    div: float = 1
+    n_heads: int = 1
+    in_types: Optional[dict] = None
+    out_types: Optional[dict] = None
+
+    @nn.compact
+    def __call__(self, h: Dict[int, jnp.ndarray], g: GraphBatch):
+        fin = Fiber(dictionary=self.in_types or {0: 1, 1: 1})
+        fmid = Fiber(self.num_degrees, self.num_channels)
+        fout = Fiber(dictionary=self.out_types or {1: 1})
+
+        rel = gather_nodes(g.loc, g.row) - gather_nodes(g.loc, g.col)
+        basis, r = compute_basis_and_r(rel, self.num_degrees - 1)
+
+        f = fin
+        for i in range(self.num_layers):
+            h = GSE3Res(f, fmid, edge_dim=self.edge_dim, div=self.div,
+                        n_heads=self.n_heads, name=f"res_{i}")(h, g, r, basis)
+            h = GNormSE3(fmid, name=f"norm_{i}")(h)
+            f = fmid
+        h = GConvSE3(f, fout, self_interaction=True, edge_dim=self.edge_dim,
+                     name=f"conv_out")(h, g, r, basis)
+
+        scalar_trick = self.param("scalar_trick", lambda k, s: 0.01 * jnp.ones(s), (1,))
+        return {d: v * scalar_trick for d, v in h.items()}

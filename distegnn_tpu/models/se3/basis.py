@@ -1,0 +1,50 @@
+"""Runtime equivariant basis — jnp, traced inside the jitted step.
+
+Reference get_basis/get_basis_and_r (modules.py:19-77) computes, per forward
+pass under no_grad, the kernel bases K_J(d) = Y_J(d) @ Q_J^T for every
+(d_in, d_out) degree pair. Here the spherical harmonics are the closed-form
+jnp evaluation of the SAME formulas as the host solver (so3.real_sph_harm with
+xp=jnp), the Q_J are float32 constants baked into the traced program, and the
+whole computation is stop_gradient'ed (parity with the reference's no_grad)."""
+
+from __future__ import annotations
+
+from typing import Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from distegnn_tpu.models.se3.so3 import q_matrices, real_sph_harm
+
+
+def cart_to_deg1(v: jnp.ndarray) -> jnp.ndarray:
+    """Cartesian vector -> degree-1 irrep component order. Our l=1 real
+    harmonics are sqrt(3/4pi) * (y, z, x)/r (m = -1, 0, 1), so a cartesian
+    vector enters the representation basis by the (y, z, x) permutation."""
+    return v[..., jnp.array([1, 2, 0])]
+
+
+def deg1_to_cart(f: jnp.ndarray) -> jnp.ndarray:
+    """Inverse of cart_to_deg1."""
+    return f[..., jnp.array([2, 0, 1])]
+
+
+def compute_basis_and_r(rel_pos: jnp.ndarray, max_degree: int
+                        ) -> Tuple[Dict[Tuple[int, int], jnp.ndarray], jnp.ndarray]:
+    """rel_pos [B, E, 3] (x_dst - x_src, padded edges may be zero) ->
+      basis dict[(d_in, d_out)] -> [B, E, 2 d_out+1, 2 d_in+1, num_freq]
+      r [B, E, 1] distances.
+
+    Mirrors reference get_basis_and_r; padded zero edges produce the guarded
+    north-pole harmonic value, masked out downstream."""
+    Y = {l: real_sph_harm(l, rel_pos, xp=jnp) for l in range(2 * max_degree + 1)}
+    Q = q_matrices(max_degree)
+    basis = {}
+    for (d_in, d_out), Q_Js in Q.items():
+        K_Js = []
+        for J, Q_J in zip(range(abs(d_in - d_out), d_in + d_out + 1), Q_Js):
+            K_J = jnp.einsum("bej,mj->bem", Y[J], jnp.asarray(Q_J))  # [B,E,(2do+1)(2di+1)]
+            K_Js.append(K_J.reshape(K_J.shape[:2] + (2 * d_out + 1, 2 * d_in + 1)))
+        basis[(d_in, d_out)] = jax.lax.stop_gradient(jnp.stack(K_Js, axis=-1))
+    r = jnp.sqrt(jnp.sum(rel_pos**2, axis=-1, keepdims=True))
+    return basis, r

@@ -1,0 +1,60 @@
+"""TFN dynamics wrapper — the 'TFN' baseline (reference
+se3_dynamics/dynamics.py OurDynamics with model='tfn', built by main.py:87-89
+as nf=hidden//2, num_degrees=2).
+
+Features: degree-0 = charges [B,N,1,1], degree-1 = velocity [B,N,1,3]
+(reference dynamics.py:85-91: ndata f/f1); output = degree-1 channel + input
+positions (dynamics.py:103)."""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax.numpy as jnp
+from flax import linen as nn
+
+from distegnn_tpu.models.se3.basis import cart_to_deg1, deg1_to_cart
+from distegnn_tpu.models.se3.tfn import TFN
+from distegnn_tpu.ops.graph import GraphBatch
+
+
+def _in_features(g: GraphBatch):
+    charges = g.node_attr if g.node_attr.shape[-1] else g.node_feat[..., -1:]
+    return {0: charges[..., None],                         # [B, N, 1, 1]
+            1: cart_to_deg1(g.vel)[:, :, None, :]}         # [B, N, 1, 3] irrep basis
+
+
+class TFNDynamics(nn.Module):
+    nf: int = 32
+    n_layers: int = 3
+    num_degrees: int = 2
+
+    @nn.compact
+    def __call__(self, g: GraphBatch) -> Tuple[jnp.ndarray, None]:
+        out = TFN(num_layers=self.n_layers, num_channels=self.nf,
+                  num_degrees=self.num_degrees, in_types={0: 1, 1: 1},
+                  out_types={1: 1}, name="tfn")(_in_features(g), g)
+        x = g.loc + deg1_to_cart(out[1][:, :, 0, :]) * g.node_mask[..., None]
+        return x, None
+
+
+class SE3TransformerDynamics(nn.Module):
+    """OurDynamics with model='se3_transformer' (reference dynamics.py:16-18):
+    attention stack instead of plain TFN convs, same feature plumbing."""
+
+    nf: int = 32
+    n_layers: int = 3
+    num_degrees: int = 2
+    div: float = 1
+    n_heads: int = 1
+
+    @nn.compact
+    def __call__(self, g: GraphBatch) -> Tuple[jnp.ndarray, None]:
+        from distegnn_tpu.models.se3.attention import SE3Transformer
+
+        out = SE3Transformer(num_layers=self.n_layers, num_channels=self.nf,
+                             num_degrees=self.num_degrees, div=self.div,
+                             n_heads=self.n_heads, in_types={0: 1, 1: 1},
+                             out_types={1: 1}, name="se3t")(_in_features(g), g)
+        x = g.loc + deg1_to_cart(out[1][:, :, 0, :]) * g.node_mask[..., None]
+        return x, None

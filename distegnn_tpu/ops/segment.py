@@ -46,6 +46,32 @@ def segment_mean(data, segment_ids, num_segments, mask=None):
     return total / count.reshape((num_segments,) + (1,) * (data.ndim - 1))
 
 
+def segment_max(data, segment_ids, num_segments, mask=None, initial=-1e30):
+    """Per-segment max; empty segments yield ``initial``. Masked rows are
+    replaced by ``initial`` before the scatter so they never win."""
+    if mask is not None:
+        m = mask.reshape(mask.shape + (1,) * (data.ndim - 1)).astype(bool)
+        data = jnp.where(m, data, initial)
+    out_shape = (num_segments,) + data.shape[1:]
+    return jnp.full(out_shape, initial, dtype=data.dtype).at[segment_ids].max(data)
+
+
+def segment_softmax(scores, segment_ids, num_segments, mask=None):
+    """Numerically-stable softmax over rows sharing a segment id (the TPU
+    replacement for DGL's edge_softmax, reference modules.py:542). Masked rows
+    get weight 0; segments with no rows produce all-zero weights."""
+    if mask is not None:
+        # mask BEFORE the exp: a masked row's raw score may exceed its
+        # segment's real max, and exp(large) * 0 would be NaN
+        m = mask.reshape(mask.shape + (1,) * (scores.ndim - 1)).astype(bool)
+        scores = jnp.where(m, scores, -1e30)
+    mx = segment_max(scores, segment_ids, num_segments)
+    shifted = jnp.maximum(scores - mx[segment_ids], -80.0)
+    e = jnp.where(scores > -1e29, jnp.exp(shifted), 0.0)
+    denom = segment_sum(e, segment_ids, num_segments)
+    return e / jnp.maximum(denom[segment_ids], 1e-30)
+
+
 def masked_sum(data, mask, axis):
     """Sum over ``axis`` counting only mask==1 elements. mask broadcasts from the left."""
     m = mask.astype(data.dtype).reshape(mask.shape + (1,) * (data.ndim - mask.ndim))

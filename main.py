@@ -36,7 +36,7 @@ def count_parameters(params) -> int:
     return sum(int(np.prod(p.shape)) for p in jax.tree.leaves(params))
 
 
-def process_dataset_edge_cutoff(data_cfg):
+def process_dataset_edge_cutoff(data_cfg, seed: int = 0):
     """Dispatch by dataset (reference process_dataset_edge_cutoff,
     datasets/process_dataset.py:32-45)."""
     name = data_cfg.dataset_name
@@ -55,6 +55,7 @@ def process_dataset_edge_cutoff(data_cfg):
             data_cfg.data_dir, name, data_cfg.max_samples, data_cfg.radius,
             data_cfg.delta_t, data_cfg.cutoff_rate, backbone=data_cfg.backbone,
             test_rot=data_cfg.test_rot, test_trans=data_cfg.test_trans,
+            seed=seed,
         )
     if name == "Water-3D":
         try:
@@ -64,7 +65,7 @@ def process_dataset_edge_cutoff(data_cfg):
 
         return process_water3d_cutoff(
             data_cfg.data_dir, name, data_cfg.max_samples, data_cfg.radius,
-            data_cfg.delta_t, data_cfg.cutoff_rate,
+            data_cfg.delta_t, data_cfg.cutoff_rate, seed=seed,
         )
     raise NotImplementedError(f"{name} has no cutoff-mode processor")
 
@@ -92,7 +93,7 @@ def main(argv=None):
     fix_seed(config.seed)
 
     # Data
-    files = process_dataset_edge_cutoff(config.data)
+    files = process_dataset_edge_cutoff(config.data, seed=config.seed)
     ds_train, ds_valid, ds_test = (GraphDataset(f) for f in files)
     print(f"Data ready: {len(ds_train)}/{len(ds_valid)}/{len(ds_test)} graphs")
     mk = lambda ds, shuffle: GraphLoader(

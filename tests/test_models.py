@@ -27,6 +27,14 @@ def _pair(rng, **kw):
 
 
 MODELS = {
+    "TFN": lambda: __import__("distegnn_tpu.models.se3.dynamics", fromlist=["TFNDynamics"]
+                              ).TFNDynamics(nf=8, n_layers=2, num_degrees=2),
+    "SE3Transformer": lambda: __import__(
+        "distegnn_tpu.models.se3.dynamics", fromlist=["SE3TransformerDynamics"]
+    ).SE3TransformerDynamics(nf=8, n_layers=2, num_degrees=2, n_heads=2),
+    "FastTFN": lambda: __import__("distegnn_tpu.models.fast_tfn", fromlist=["FastTFN"]
+                                  ).FastTFN(node_feat_nf=1, node_attr_nf=0, edge_attr_nf=1,
+                                            hidden_nf=16, virtual_channels=2, n_layers=2),
     "FastRF": lambda: FastRF(edge_attr_nf=1, hidden_nf=32, virtual_channels=3, n_layers=3),
     "FastSchNet": lambda: FastSchNet(node_feat_nf=1, edge_attr_nf=1, hidden_nf=32,
                                      virtual_channels=3, n_layers=2, cutoff=10.0),
@@ -112,7 +120,8 @@ def test_registry_serves_all_families(rng):
                 virtual_channels=2, node_feat_nf=1, node_attr_nf=0, edge_attr_nf=1,
                 checkpoint=None)
     gb = pad_graphs([_random_graph(rng)])
-    for name in ("FastEGNN", "FastRF", "FastSchNet", "SchNet", "EGNN", "RF", "Linear"):
+    for name in ("FastEGNN", "FastRF", "FastSchNet", "SchNet", "EGNN", "RF", "Linear",
+                 "TFN", "FastTFN", "SE3Transformer"):
         cfg = ConfigDict(dict(base, model_name=name))
         model = get_model(cfg, world_size=1, dataset_name="nbody_100")
         params = model.init(jax.random.PRNGKey(0), gb)

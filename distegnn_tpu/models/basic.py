@@ -105,6 +105,105 @@ class InvariantScalarNet(nn.Module):
                        flat=self.flat, name="scalar_net")(s)
 
 
+class EquivariantEdgeScalarNet(nn.Module):
+    """Per-edge O(n)-equivariant net (reference basic.py:467-507): cross-Gram
+    Z_j^T Z_i -> MLP -> KxK recombination matrix applied to Z_j. Returns
+    (vectors [.., 3, K], scalars [.., H]). The vector count K comes from the
+    input shape."""
+
+    hidden_dim: int
+    norm: bool = True
+    flat: bool = False
+
+    @nn.compact
+    def __call__(self, vectors_i, vectors_j, scalars=None):
+        K = vectors_i.shape[-1]
+        s = jnp.einsum("...dj,...dk->...jk", vectors_j, vectors_i)
+        s = s.reshape(s.shape[:-2] + (K * K,))
+        if self.norm:
+            s = s / jnp.maximum(jnp.linalg.norm(s, axis=-1, keepdims=True), 1e-12)
+        if scalars is not None:
+            s = jnp.concatenate([s, scalars], axis=-1)
+        s = BaseMLP(self.hidden_dim, self.hidden_dim, last_act=True, flat=self.flat,
+                    name="in_scalar_net")(s)
+        coef = BaseMLP(self.hidden_dim, K * K, flat=self.flat, name="out_vector_net")(s)
+        coef = coef.reshape(coef.shape[:-1] + (K, K))
+        vector = jnp.einsum("...dj,...jk->...dk", vectors_j, coef)
+        return vector, s
+
+
+class EGMN(nn.Module):
+    """Stacked EquivariantScalarNet over a growing vector list (reference
+    EGMN, basic.py:339-356)."""
+
+    n_layers: int
+    n_vector_input: int
+    hidden_dim: int
+    norm: bool = False
+    flat: bool = False
+
+    @nn.compact
+    def __call__(self, vectors, scalars):
+        cur = list(vectors)
+        for i in range(self.n_layers):
+            vector, scalars = EquivariantScalarNet(
+                n_vector_input=self.n_vector_input + i, hidden_dim=self.hidden_dim,
+                norm=self.norm, flat=self.flat, name=f"layer_{i}",
+            )(cur, scalars)
+            cur.append(vector)
+        return cur[-1], scalars
+
+
+class EGCLClassic(nn.Module):
+    """The classic EGNN conv (reference E_GCL, basic.py:69-164; superseded by
+    EGNNLayer in the factory but part of the model library): sum-aggregated
+    edge messages, (1+|r|)-normalized coordinate differences, residual node
+    update."""
+
+    hidden_nf: int
+    edge_attr_nf: int = 0
+    recurrent: bool = True
+    attention: bool = False
+    clamp: bool = False
+    tanh: bool = False
+    coords_weight: float = 1.0
+
+    @nn.compact
+    def __call__(self, h, x, g: GraphBatch):
+        N = x.shape[1]
+        row, col = g.row, g.col
+        coord_diff = gather_nodes(x, row) - gather_nodes(x, col)
+        radial = jnp.sum(coord_diff**2, axis=-1, keepdims=True)
+        coord_diff = coord_diff / (jnp.sqrt(radial + 1e-8) + 1.0)
+
+        e_in = [gather_nodes(h, row), gather_nodes(h, col), radial]
+        if self.edge_attr_nf:
+            e_in.append(g.edge_attr)
+        ef = MLP([self.hidden_nf, self.hidden_nf], act_last=True,
+                 name="edge_mlp")(jnp.concatenate(e_in, axis=-1))
+        if self.attention:
+            ef = ef * jax.nn.sigmoid(TorchDense(1, name="att_mlp")(ef))
+        ef = ef * g.edge_mask[..., None]
+
+        gate = MLP([self.hidden_nf, 1], use_bias_last=False,
+                   kernel_init_last=coord_head_init, name="coord_mlp")(ef)
+        if self.tanh:
+            gate = jnp.tanh(gate)
+        trans = coord_diff * gate
+        if self.clamp:
+            trans = jnp.clip(trans, -100.0, 100.0)
+        from distegnn_tpu.ops.segment import segment_sum
+
+        agg_x = jax.vmap(lambda t, r, e: segment_mean(t, r, N, mask=e))(trans, row, g.edge_mask)
+        x = x + agg_x * self.coords_weight
+
+        agg_h = jax.vmap(lambda t, r, e: segment_sum(t, r, N, mask=e))(ef, row, g.edge_mask)
+        out = MLP([self.hidden_nf, self.hidden_nf],
+                  name="node_mlp")(jnp.concatenate([h, agg_h], axis=-1))
+        h = h + out if self.recurrent else out
+        return h * g.node_mask[..., None], x * g.node_mask[..., None]
+
+
 class EGNNLayer(nn.Module):
     """Scalarization-based EGNN conv with velocity head and the +-100 force
     clamp (reference EGNN_Layer, basic.py:280-306)."""

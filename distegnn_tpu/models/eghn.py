@@ -1,0 +1,211 @@
+"""EGHN — Equivariant Hierarchical Network (reference EGHN + PoolingLayer/
+PoolingNet, basic.py:510-731; present in the reference model library but
+never served by its factory).
+
+Pipeline per forward: low-level EGNN force -> learned soft cluster assignment
+(PoolingNet over equivariant edge messages) -> cluster-pooled high-level graph
+(full P x P edges weighted by the pooled adjacency, self-loops included as in
+the reference, whose construct_edges mask is built then ignored,
+basic.py:723-731) -> high-level EGNN -> equivariant kinematics decode
+(EquivariantScalarNet / EGMN) back onto nodes. The normalized-cut auxiliary
+loss is returned alongside the prediction.
+
+Dense-batch delta: the reference flattens [B*N] and reshapes around every
+einsum; the [B, N, ...] GraphBatch layout removes all of that."""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from flax import linen as nn
+
+from distegnn_tpu.models.basic import (
+    BaseMLP,
+    EGMN,
+    EGNNLayer,
+    EquivariantEdgeScalarNet,
+    EquivariantScalarNet,
+)
+from distegnn_tpu.models.common import TorchDense, gather_nodes
+from distegnn_tpu.ops.graph import GraphBatch
+from distegnn_tpu.ops.segment import segment_mean, segment_sum
+
+
+class PoolingLayer(nn.Module):
+    """Vector+scalar message passing step of the pooling net (reference
+    basic.py:510-540)."""
+
+    hidden_nf: int
+    n_vector_input: int
+    edge_attr_nf: int = 0
+    flat: bool = False
+
+    @nn.compact
+    def __call__(self, vectors, h, g: GraphBatch):
+        N = h.shape[1]
+        row, col = g.row, g.col
+        hij = [gather_nodes(h, row), gather_nodes(h, col)]
+        if self.edge_attr_nf:
+            hij.append(g.edge_attr)
+        B = vectors.shape[0]
+        vec_flat = vectors.reshape(B, N, -1)
+        v_i = gather_nodes(vec_flat, row).reshape(vectors.shape[:1] + (row.shape[1],) + vectors.shape[2:])
+        v_j = gather_nodes(vec_flat, col).reshape(v_i.shape)
+        vec_out, msg = EquivariantEdgeScalarNet(
+            hidden_dim=self.hidden_nf, norm=True, flat=self.flat,
+            name="edge_message_net",
+        )(v_i, v_j, scalars=jnp.concatenate(hij, axis=-1))
+        vec_out = vec_out * g.edge_mask[..., None, None]
+        msg = msg * g.edge_mask[..., None]
+
+        vflat = vec_out.reshape(vec_out.shape[:2] + (-1,))
+        agg_v = jax.vmap(lambda t, r, e: segment_mean(t, r, N, mask=e))(vflat, row, g.edge_mask)
+        vectors = vectors + agg_v.reshape(vectors.shape)
+        agg_m = jax.vmap(lambda t, r, e: segment_sum(t, r, N, mask=e))(msg, row, g.edge_mask)
+        h = h + BaseMLP(self.hidden_nf, self.hidden_nf, flat=self.flat, name="node_net")(
+            jnp.concatenate([h, agg_m], axis=-1))
+        return vectors, h
+
+
+class PoolingNet(nn.Module):
+    """Stacked PoolingLayers + a tanh MLP head to cluster logits (reference
+    basic.py:543-563)."""
+
+    n_layers: int
+    n_vector_input: int
+    hidden_nf: int
+    output_nf: int
+    edge_attr_nf: int = 0
+    flat: bool = False
+
+    @nn.compact
+    def __call__(self, vectors, h, g: GraphBatch):
+        if isinstance(vectors, (list, tuple)):
+            vectors = jnp.stack(vectors, axis=-1)       # [B, N, 3, V]
+        for i in range(self.n_layers):
+            vectors, h = PoolingLayer(
+                hidden_nf=self.hidden_nf, n_vector_input=self.n_vector_input,
+                edge_attr_nf=self.edge_attr_nf, flat=self.flat, name=f"layer_{i}",
+            )(vectors, h, g)
+        h = TorchDense(8 * self.hidden_nf, name="pool_0")(h)
+        h = jnp.tanh(h)
+        return TorchDense(self.output_nf, name="pool_1")(h)
+
+
+def _full_cluster_batch(X, V, H_feat, AA, P):
+    """GraphBatch over the P-cluster graph: full P x P edges (self-loops
+    included, matching the reference's effective behavior), edge_attr = pooled
+    adjacency weights."""
+    import numpy as np
+
+    B = X.shape[0]
+    row = jnp.asarray(np.repeat(np.arange(P), P))[None, :].repeat(B, axis=0)
+    col = jnp.asarray(np.tile(np.arange(P), P))[None, :].repeat(B, axis=0)
+    edge_attr = AA.reshape(B, P * P, 1)
+    ones_e = jnp.ones((B, P * P), X.dtype)
+    ones_n = jnp.ones((B, P), X.dtype)
+    return GraphBatch(
+        node_feat=H_feat, node_attr=jnp.zeros((B, P, 0), X.dtype), loc=X, vel=V,
+        target=jnp.zeros_like(X), loc_mean=jnp.mean(X, axis=1),
+        node_mask=ones_n, edge_index=jnp.stack([row, col], axis=1),
+        edge_attr=edge_attr, edge_mask=ones_e,
+    )
+
+
+class EGHN(nn.Module):
+    """Reference EGHN (basic.py:566-711). Returns (loc_pred, None).
+
+    The normalized-cut auxiliary loss is sown into the 'aux' collection; to
+    consume it, call ``out, state = model.apply(params, g, mutable=['aux'])``
+    and read ``state['aux']['cut_loss']`` — a plain ``apply(params, g)``
+    silently drops it (flax semantics), so a trainer adding the reference's
+    cut-loss term (basic.py:713-716) MUST pass mutable=['aux']."""
+
+    in_node_nf: int
+    in_edge_nf: int
+    hidden_nf: int
+    n_cluster: int = 4
+    layer_per_block: int = 3
+    layer_pooling: int = 3
+    layer_decoder: int = 1
+    with_v: bool = True
+    flat: bool = False
+    norm: bool = False
+
+    @nn.compact
+    def __call__(self, g: GraphBatch) -> Tuple[jnp.ndarray, None]:
+        P = self.n_cluster
+        x, v = g.loc, g.vel
+        nmask = g.node_mask[..., None]
+        h = TorchDense(self.hidden_nf, name="embedding")(g.node_feat)
+
+        # low-level force
+        hx, hv, hh = x, v, h
+        for i in range(self.layer_per_block):
+            hx, hv, hh = EGNNLayer(hidden_nf=self.hidden_nf, edge_attr_nf=self.in_edge_nf,
+                                   with_v=self.with_v, flat=self.flat, norm=self.norm,
+                                   name=f"low_{i}")(hx, hh, hv, g)
+        nf = hx - x
+
+        # pooling assignment (local edges := the same graph edges; the
+        # reference's factory never wires a separate local edge set)
+        x_mean = jnp.sum(x * nmask, axis=1, keepdims=True) / jnp.maximum(
+            jnp.sum(nmask, axis=1, keepdims=True), 1.0)
+        vecs = [x - x_mean, nf, v] if self.with_v else [x - x_mean, nf]
+        pooling_fea = PoolingNet(
+            n_layers=self.layer_pooling, n_vector_input=len(vecs),
+            hidden_nf=self.hidden_nf, output_nf=P, edge_attr_nf=self.in_edge_nf,
+            flat=self.flat, name="low_pooling",
+        )(vecs, hh, g)                                             # [B, N, P]
+        s = jax.nn.softmax(pooling_fea, axis=-1) * nmask           # [B, N, P]
+
+        # cluster aggregation
+        count = jnp.maximum(jnp.sum(s, axis=1), 1e-5)[..., None]   # [B, P, 1]
+        X = jnp.einsum("bnp,bnd->bpd", s, x) / count
+        H = jnp.einsum("bnp,bnd->bpd", s, hh) / count
+        NF = jnp.einsum("bnp,bnd->bpd", s, nf) / count
+        V = jnp.einsum("bnp,bnd->bpd", s, v) / count if self.with_v else None
+
+        # pooled adjacency + cut loss (reference basic.py:667-676,713-716)
+        N = x.shape[1]
+        a = jax.vmap(lambda sp, r, c, e: segment_sum(
+            sp[c] * e[:, None], r, N))(s, g.row, g.col, g.edge_mask)  # [B, N, P]
+        A = jnp.einsum("bnp,bnq->bpq", s, a)                          # [B, P, P]
+        A_n = A / jnp.maximum(jnp.linalg.norm(A, axis=2, keepdims=True), 1e-12)
+        cut_loss = jnp.mean(jnp.linalg.norm(
+            (A_n - jnp.eye(P)).reshape(A.shape[0], -1), axis=-1))
+        self.sow("aux", "cut_loss", cut_loss)
+
+        # high-level message passing on the full cluster graph
+        gc = _full_cluster_batch(X, V if V is not None else jnp.zeros_like(X), H, A, P)
+        cx, cv, ch = gc.loc, (gc.vel if self.with_v else None), H
+        for i in range(self.layer_per_block):
+            cx, cv, ch = EGNNLayer(hidden_nf=self.hidden_nf, edge_attr_nf=1,
+                                   with_v=self.with_v, flat=self.flat,
+                                   name=f"high_{i}")(cx, ch, cv, gc)
+        h_nf = cx - X
+        X2 = X + h_nf
+
+        # low-level kinematics decode
+        l_nf = jnp.einsum("bnp,bpd->bnd", s, h_nf)
+        l_X = jnp.einsum("bnp,bpd->bnd", s, X)
+        l_H = jnp.einsum("bnp,bpd->bnd", s, ch)
+        if self.with_v:
+            l_V = jnp.einsum("bnp,bpd->bnd", s, cv)
+            vectors = [l_nf, x - l_X, v - l_V, nf]
+        else:
+            vectors = [l_nf, x - l_X, nf]
+        scalars = jnp.concatenate([hh, l_H], axis=-1)
+        if self.layer_decoder == 1:
+            l_kin, _ = EquivariantScalarNet(
+                n_vector_input=len(vectors), hidden_dim=self.hidden_nf,
+                norm=True, flat=self.flat, name="kinematics_net",
+            )(vectors, scalars)
+        else:
+            l_kin, _ = EGMN(n_layers=self.layer_decoder, n_vector_input=len(vectors),
+                            hidden_dim=self.hidden_nf, norm=True, flat=self.flat,
+                            name="kinematics_net")(vectors, scalars)
+        x_out = jnp.einsum("bnp,bpd->bnd", s, X2) + l_kin
+        return x_out * nmask, None

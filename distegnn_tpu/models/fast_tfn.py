@@ -62,7 +62,9 @@ class TFNGCLVel(nn.Module):
             edge_feat = edge_feat * jax.nn.sigmoid(TorchDense(1, name="att")(edge_feat))
         edge_feat = edge_feat * edge_mask[..., None]
 
-        coord_mean = global_node_mean(x, node_mask, axis_name=None)   # LOCAL (single-device model)
+        # LOCAL with the default axis_name=None (reference FastTFN is
+        # single-device, FastTFN.py:217); honors the mesh axis when set
+        coord_mean = global_node_mean(x, node_mask, self.axis_name)
         Xc = X - coord_mean[:, :, None]
         m_X = jnp.einsum("bdc,bde->bce", Xc, Xc)
 

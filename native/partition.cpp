@@ -1,0 +1,165 @@
+// In-tree graph partitioner — the native replacement for libmetis.
+//
+// The reference reaches METIS through torch-sparse / pyg-lib C++ bindings
+// (reference datasets/distribute_graphs.py:151-185). This implements the same
+// job as a small, dependency-free C++ library: balanced k-way partitioning by
+// recursive bisection, each bisection = greedy BFS region growing from a
+// random seed followed by Fiduccia–Mattheyses-style boundary refinement
+// (single-pass passes with per-node move gains, balance-constrained).
+// Deterministic for a given seed.
+//
+// C ABI (ctypes-friendly):
+//   int partition_graph(int64_t n, const int64_t* indptr,
+//                       const int64_t* indices, int32_t nparts,
+//                       uint64_t seed, int32_t* labels_out)
+// Returns 0 on success. CSR adjacency must be symmetric (undirected).
+
+#include <cstdint>
+#include <cstring>
+#include <queue>
+#include <random>
+#include <vector>
+
+namespace {
+
+struct Csr {
+  int64_t n;
+  const int64_t* indptr;
+  const int64_t* indices;
+};
+
+// Grow a connected region of `take` nodes by BFS from a random seed node.
+// Returns a 0/1 side assignment over `nodes` (local indices).
+std::vector<uint8_t> grow_bisection(const Csr& g,
+                                    const std::vector<int64_t>& nodes,
+                                    const std::vector<int64_t>& local_of,
+                                    int64_t take, std::mt19937_64& rng) {
+  const int64_t n = static_cast<int64_t>(nodes.size());
+  std::vector<uint8_t> side(n, 1);  // 1 = right, 0 = left (grown region)
+  std::vector<uint8_t> seen(n, 0);
+  std::queue<int64_t> q;
+
+  int64_t count = 0;
+  int64_t start = static_cast<int64_t>(rng() % n);
+  q.push(start);
+  seen[start] = 1;
+  while (count < take) {
+    if (q.empty()) {
+      // disconnected remainder: restart from any unseen node
+      for (int64_t i = 0; i < n; ++i) {
+        if (!seen[i]) { q.push(i); seen[i] = 1; break; }
+      }
+      if (q.empty()) break;
+    }
+    int64_t u = q.front(); q.pop();
+    side[u] = 0;
+    ++count;
+    int64_t gu = nodes[u];
+    for (int64_t e = g.indptr[gu]; e < g.indptr[gu + 1]; ++e) {
+      int64_t lv = local_of[g.indices[e]];
+      if (lv >= 0 && !seen[lv]) { seen[lv] = 1; q.push(lv); }
+    }
+  }
+  return side;
+}
+
+// One FM-style refinement pass: move boundary nodes with positive gain while
+// keeping |left| within +-slack of `take`. Repeats until no improving pass.
+void refine(const Csr& g, const std::vector<int64_t>& nodes,
+            const std::vector<int64_t>& local_of, std::vector<uint8_t>& side,
+            int64_t take, int max_passes = 10) {
+  const int64_t n = static_cast<int64_t>(nodes.size());
+  const int64_t slack = std::max<int64_t>(1, n / 100);
+  // neither side may ever become empty: every partition must receive nodes
+  const int64_t lo = std::max<int64_t>(1, take - slack);
+  const int64_t hi = std::min<int64_t>(n - 1, take + slack);
+  int64_t left = 0;
+  for (int64_t i = 0; i < n; ++i) left += (side[i] == 0);
+
+  for (int pass = 0; pass < max_passes; ++pass) {
+    int64_t moved = 0;
+    for (int64_t i = 0; i < n; ++i) {
+      int64_t gi = nodes[i];
+      int64_t same = 0, other = 0;
+      for (int64_t e = g.indptr[gi]; e < g.indptr[gi + 1]; ++e) {
+        int64_t lv = local_of[g.indices[e]];
+        if (lv < 0) continue;
+        if (side[lv] == side[i]) ++same; else ++other;
+      }
+      int64_t gain = other - same;  // cut edges removed by moving i
+      if (gain <= 0) continue;
+      // balance constraint
+      if (side[i] == 0) {
+        if (left - 1 < lo) continue;
+        side[i] = 1; --left;
+      } else {
+        if (left + 1 > hi) continue;
+        side[i] = 0; ++left;
+      }
+      ++moved;
+    }
+    if (moved == 0) break;
+  }
+}
+
+void recurse(const Csr& g, std::vector<int64_t>& nodes,
+             std::vector<int64_t>& local_of, int32_t parts, int32_t base,
+             std::mt19937_64& rng, int32_t* labels) {
+  const int64_t n = static_cast<int64_t>(nodes.size());
+  if (parts <= 1) {
+    for (int64_t i = 0; i < n; ++i) labels[nodes[i]] = base;
+    return;
+  }
+  if (n <= parts) {  // degenerate: one node per part, surplus parts empty
+    for (int64_t i = 0; i < n; ++i) labels[nodes[i]] = base + static_cast<int32_t>(i);
+    return;
+  }
+  const int32_t lparts = parts / 2;
+  const int64_t take = (n * lparts + parts / 2) / parts;
+
+  // local index map for this region
+  for (int64_t i = 0; i < n; ++i) local_of[nodes[i]] = i;
+  auto side = grow_bisection(g, nodes, local_of, take, rng);
+  refine(g, nodes, local_of, side, take);
+  for (int64_t i = 0; i < n; ++i) local_of[nodes[i]] = -1;
+
+  std::vector<int64_t> lnodes, rnodes;
+  lnodes.reserve(take); rnodes.reserve(n - take);
+  for (int64_t i = 0; i < n; ++i) {
+    (side[i] == 0 ? lnodes : rnodes).push_back(nodes[i]);
+  }
+  nodes.clear(); nodes.shrink_to_fit();
+  recurse(g, lnodes, local_of, lparts, base, rng, labels);
+  recurse(g, rnodes, local_of, parts - lparts, base + lparts, rng, labels);
+}
+
+}  // namespace
+
+extern "C" {
+
+int partition_graph(int64_t n, const int64_t* indptr, const int64_t* indices,
+                    int32_t nparts, uint64_t seed, int32_t* labels_out) {
+  if (n <= 0 || nparts <= 0) return 1;
+  Csr g{n, indptr, indices};
+  std::mt19937_64 rng(seed);
+  std::vector<int64_t> nodes(n);
+  for (int64_t i = 0; i < n; ++i) nodes[i] = i;
+  std::vector<int64_t> local_of(n, -1);
+  recurse(g, nodes, local_of, nparts, 0, rng, labels_out);
+  return 0;
+}
+
+// Edge cut of a labeling (for tests/diagnostics): counts directed CSR entries
+// crossing parts (each undirected edge counted twice).
+int64_t edge_cut(int64_t n, const int64_t* indptr, const int64_t* indices,
+                 const int32_t* labels) {
+  int64_t cut = 0;
+  for (int64_t u = 0; u < n; ++u) {
+    for (int64_t e = indptr[u]; e < indptr[u + 1]; ++e) {
+      cut += (labels[u] != labels[indices[e]]);
+    }
+  }
+  return cut;
+}
+
+}  // extern "C"

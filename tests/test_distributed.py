@@ -58,8 +58,12 @@ def test_partition_covers_all_nodes_balanced(rng, method):
     assert labels.shape == (64,)
     counts = np.bincount(labels, minlength=NPARTS)
     assert counts.sum() == 64 and (counts > 0).all()
-    if method in ("random", "metis"):
+    if method == "random":
         assert counts.max() - counts.min() <= 1  # exact balance
+    elif method == "metis":
+        # like METIS, the refining partitioner trades exact balance for cut
+        # quality within a small slack (+-1 per bisection level)
+        assert counts.max() - counts.min() <= 2 * NPARTS.bit_length()
     parts = split_graph(g, NPARTS, method, inner_radius=1.5, outer_radius=2.0, seed=0)
     assert sum(p["loc"].shape[0] for p in parts) == 64
     for p in parts:

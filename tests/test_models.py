@@ -35,6 +35,9 @@ MODELS = {
     "FastTFN": lambda: __import__("distegnn_tpu.models.fast_tfn", fromlist=["FastTFN"]
                                   ).FastTFN(node_feat_nf=1, node_attr_nf=0, edge_attr_nf=1,
                                             hidden_nf=16, virtual_channels=2, n_layers=2),
+    "EGHN": lambda: __import__("distegnn_tpu.models.eghn", fromlist=["EGHN"]).EGHN(
+        in_node_nf=1, in_edge_nf=1, hidden_nf=16, n_cluster=3,
+        layer_per_block=2, layer_pooling=2),
     "FastRF": lambda: FastRF(edge_attr_nf=1, hidden_nf=32, virtual_channels=3, n_layers=3),
     "FastSchNet": lambda: FastSchNet(node_feat_nf=1, edge_attr_nf=1, hidden_nf=32,
                                      virtual_channels=3, n_layers=2, cutoff=10.0),
@@ -95,6 +98,29 @@ def test_fast_schnet_normalize_equivariance(rng):
     out_r, _ = model.apply(params, gb_r)
     np.testing.assert_allclose(np.asarray(out[0]) @ R + t, np.asarray(out_r[0]),
                                atol=1e-4, rtol=0)
+
+
+def test_egcl_classic_and_egmn_run(rng):
+    """Library classes outside the factory (reference E_GCL basic.py:69-164,
+    EGMN basic.py:339-356) stay importable and equivariant-sane."""
+    from distegnn_tpu.models.basic import EGCLClassic, EGMN
+
+    g = _random_graph(rng)
+    gb = pad_graphs([g], node_bucket=1, edge_bucket=1)
+    layer = EGCLClassic(hidden_nf=16, edge_attr_nf=1)
+    h0 = np.tile(gb.node_feat, (1, 1, 16)).astype(np.float32)
+    params = layer.init(jax.random.PRNGKey(0), h0, gb.loc, gb)
+    h1, x1 = layer.apply(params, h0, gb.loc, gb)
+    assert np.all(np.isfinite(np.asarray(x1)))
+
+    net = EGMN(n_layers=2, n_vector_input=2, hidden_dim=8)
+    Z = [rng.normal(size=(5, 3)).astype(np.float32) for _ in range(2)]
+    s = rng.normal(size=(5, 8)).astype(np.float32)
+    p = net.init(jax.random.PRNGKey(1), Z, s)
+    vec, sc = net.apply(p, Z, s)
+    R = random_rotate(rng).astype(np.float32)
+    vec_r, sc_r = net.apply(p, [z @ R for z in Z], s)
+    np.testing.assert_allclose(np.asarray(vec) @ R, np.asarray(vec_r), atol=1e-5)
 
 
 def test_equivariant_scalar_net(rng):

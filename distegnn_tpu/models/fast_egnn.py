@@ -23,7 +23,7 @@ import jax
 import jax.numpy as jnp
 from flax import linen as nn
 
-from distegnn_tpu.models.common import MLP, CoordMLP, TorchDense, gather_nodes
+from distegnn_tpu.models.common import MLP, CoordMLP, TorchDense, gather_nodes, resolve_dtype
 from distegnn_tpu.ops.graph import GraphBatch
 from distegnn_tpu.ops.segment import segment_sum, segment_mean
 from distegnn_tpu.parallel.collectives import global_node_mean
@@ -49,6 +49,11 @@ class EGCLVel(nn.Module):
     has_gravity: bool = False
     axis_name: Optional[str] = None  # mesh axis of graph partitions ('graph') or None
     epsilon: float = 1e-8
+    # compute dtype of the invariant-message MLPs ('bf16' or None=f32). All
+    # GEOMETRY (coord_diff, radial, coordinate updates, aggregations) stays
+    # f32, so equivariance is exact at math level — bf16 only widens noise in
+    # invariant channels. See tests/test_equivariance.py::test_bf16.
+    compute_dtype: Optional[str] = None
 
     @nn.compact
     def __call__(
@@ -62,6 +67,8 @@ class EGCLVel(nn.Module):
         gravity: Optional[jnp.ndarray] = None,  # [3]
     ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray, jnp.ndarray]:
         H, C = self.hidden_nf, self.virtual_channels
+        dt = resolve_dtype(self.compute_dtype)
+        srt = g.edges_sorted
         row, col = g.row, g.col                      # [B, E]
         node_mask = g.node_mask                      # [B, N]
         edge_mask = g.edge_mask                      # [B, E]
@@ -82,11 +89,11 @@ class EGCLVel(nn.Module):
         e_in = [gather_nodes(h, row), gather_nodes(h, col), radial]
         if self.edge_attr_nf:
             e_in.append(g.edge_attr)
-        edge_feat = MLP([H, H], act_last=True, name="phi_e")(jnp.concatenate(e_in, axis=-1))
+        edge_feat = MLP([H, H], act_last=True, name="phi_e", dtype=dt)(jnp.concatenate(e_in, axis=-1))
         if self.attention:
-            gate_e = jax.nn.sigmoid(TorchDense(1, name="att")(edge_feat))
+            gate_e = jax.nn.sigmoid(TorchDense(1, name="att", dtype=dt)(edge_feat))
             edge_feat = edge_feat * gate_e                               # [B, E, H]
-        edge_feat = edge_feat * edge_mask[..., None]
+        edge_feat = edge_feat * edge_mask[..., None].astype(edge_feat.dtype)
 
         # ---------- psum #1: exact global coordinate mean (:258-261)
         coord_mean = global_node_mean(x, node_mask, self.axis_name)     # [B, 3]
@@ -106,46 +113,49 @@ class EGCLVel(nn.Module):
             ],
             axis=-1,
         )
-        vef = MLP([H, H], act_last=True, name="phi_ev")(v_in)            # [B, N, C, H]
+        vef = MLP([H, H], act_last=True, name="phi_ev", dtype=dt)(v_in)  # [B, N, C, H]
         if self.attention:
-            gate = jax.nn.sigmoid(TorchDense(1, name="att_v")(vef))
+            gate = jax.nn.sigmoid(TorchDense(1, name="att_v", dtype=dt)(vef))
             vef = vef * gate
-        vef = vef * node_mask[:, :, None, None]                          # zero padded nodes
+        vef = vef * node_mask[:, :, None, None].astype(vef.dtype)        # zero padded nodes
 
         # --- real coordinate update (coord_model_vel, :166-188)
         if self.coords_agg not in ("sum", "mean"):
             raise ValueError(f"Wrong coords_agg parameter {self.coords_agg!r}")
-        trans = coord_diff * CoordMLP(H, tanh=self.tanh, name="phi_x")(edge_feat)  # [B, E, 3]
+        trans = coord_diff * CoordMLP(H, tanh=self.tanh, name="phi_x", dtype=dt)(edge_feat)  # [B, E, 3]
         seg = segment_sum if self.coords_agg == "sum" else segment_mean
-        agg = jax.vmap(lambda t, r, m: seg(t, r, N, mask=m))(trans, row, edge_mask)  # [B, N, 3]
+        agg = jax.vmap(lambda t, r, m: seg(t, r, N, mask=m, indices_are_sorted=srt))(
+            trans, row, edge_mask)                                       # [B, N, 3]
         x = x + agg
 
-        phi_xv = CoordMLP(H, tanh=self.tanh, name="phi_xv")(vef)         # [B, N, C, 1]
+        phi_xv = CoordMLP(H, tanh=self.tanh, name="phi_xv", dtype=dt)(vef)  # [B, N, C, 1]
         trans_v = jnp.mean(-vcd * jnp.swapaxes(phi_xv, 2, 3), axis=-1)   # [B, N, 3]
         x = x + trans_v
-        x = x + MLP([H, 1], name="phi_v")(h) * v
+        x = x + MLP([H, 1], name="phi_v", dtype=dt)(h).astype(jnp.float32) * v
         if self.has_gravity:
-            x = x + MLP([H, 1], name="phi_g")(h) * gravity
+            x = x + MLP([H, 1], name="phi_g", dtype=dt)(h).astype(jnp.float32) * gravity
         x = x * nm  # keep padding clean
 
         # ---------- psum #2: virtual coordinate update (coord_model_virtual, :191-200)
-        trans_X = vcd * jnp.swapaxes(CoordMLP(H, tanh=self.tanh, name="phi_X")(vef), 2, 3)  # [B, N, 3, C]
+        trans_X = vcd * jnp.swapaxes(CoordMLP(H, tanh=self.tanh, name="phi_X", dtype=dt)(vef), 2, 3)  # [B, N, 3, C]
         X = X + global_node_mean(trans_X, node_mask, self.axis_name)     # [B, 3, C]
 
         # --- node feature update (node_model, :203-217)
-        agg_h = jax.vmap(lambda t, r, m: segment_mean(t, r, N, mask=m))(edge_feat, row, edge_mask)
+        agg_h = jax.vmap(lambda t, r, m: segment_mean(t, r, N, mask=m, indices_are_sorted=srt))(
+            edge_feat, row, edge_mask)
         agg_v = jnp.mean(vef, axis=2)                                    # [B, N, H]
         n_in = [h, agg_h, agg_v]
         if self.node_attr_nf:
             n_in.append(g.node_attr)
-        out = MLP([H, H], name="phi_h")(jnp.concatenate(n_in, axis=-1))
+        out = MLP([H, H], name="phi_h", dtype=dt)(jnp.concatenate(
+            [a.astype(jnp.float32) for a in n_in], axis=-1))
         h = (h + out) if self.residual else out
         h = h * nm
 
         # ---------- psum #3: virtual feature update (node_model_virtual, :220-234)
-        agg_Hv = global_node_mean(vef, node_mask, self.axis_name)        # [B, C, H]
+        agg_Hv = global_node_mean(vef.astype(jnp.float32), node_mask, self.axis_name)  # [B, C, H]
         hv_in = jnp.concatenate([jnp.swapaxes(Hv, 1, 2), agg_Hv], axis=-1)  # [B, C, 2H]
-        out_v = jnp.swapaxes(MLP([H, H], name="phi_hv")(hv_in), 1, 2)    # [B, H, C]
+        out_v = jnp.swapaxes(MLP([H, H], name="phi_hv", dtype=dt)(hv_in), 1, 2)  # [B, H, C]
         Hv = (Hv + out_v) if self.residual else out_v
 
         return h, x, Hv, X
@@ -172,6 +182,7 @@ class FastEGNN(nn.Module):
     tanh: bool = False
     gravity: Optional[Tuple[float, float, float]] = None
     axis_name: Optional[str] = None
+    compute_dtype: Optional[str] = None  # 'bf16' -> MXU-native message MLPs
 
     @nn.compact
     def __call__(self, g: GraphBatch) -> Tuple[jnp.ndarray, jnp.ndarray]:
@@ -185,7 +196,7 @@ class FastEGNN(nn.Module):
         # virtual coords start at the global location mean, replicated C times (:300)
         X = jnp.repeat(g.loc_mean[:, :, None], C, axis=2)                # [B, 3, C]
 
-        h = TorchDense(H, name="embedding_in")(g.node_feat)
+        h = TorchDense(H, name="embedding_in")(g.node_feat)  # f32: one small matmul
         x, v = g.loc, g.vel
         gravity = jnp.asarray(self.gravity, jnp.float32) if self.gravity is not None else None
 
@@ -201,6 +212,7 @@ class FastEGNN(nn.Module):
                 tanh=self.tanh,
                 has_gravity=self.gravity is not None,
                 axis_name=self.axis_name,
+                compute_dtype=self.compute_dtype,
                 name=f"gcl_{i}",
             )(h, x, v, X, Hv, g, gravity=gravity)
 

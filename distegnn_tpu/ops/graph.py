@@ -38,6 +38,10 @@ class GraphBatch:
       node_attr [B, N, A] float (A may be 0)
       loc_mean  [B, 3]    float — GLOBAL mean of node positions per graph
                                   (across all partitions when distributed)
+
+    ``edges_sorted`` (static) — True when every graph's edge rows are
+    ascending, including the padded tail (padding points at node N-1, the
+    last padded slot). Lets aggregations use XLA's sorted-scatter lowering.
     """
 
     node_feat: jnp.ndarray
@@ -50,6 +54,7 @@ class GraphBatch:
     edge_index: jnp.ndarray
     edge_attr: jnp.ndarray
     edge_mask: jnp.ndarray
+    edges_sorted: bool = struct.field(pytree_node=False, default=False)
 
     @property
     def batch_size(self) -> int:
@@ -120,9 +125,15 @@ def pad_graphs(
     target = np.zeros((bsz, N, 3), dtype)
     loc_mean = np.zeros((bsz, 3), dtype)
     node_mask = np.zeros((bsz, N), dtype)
-    edge_index = np.zeros((bsz, 2, E), np.int32)
+    # padded edges point at the LAST padded slot (N-1): it is masked out of
+    # every aggregation anyway, and keeps row indices ascending so the model
+    # can use XLA's sorted-scatter lowering (all in-tree edge builders emit
+    # row-sorted edge lists — radius_graph_np lexsorts, full_graph_np is
+    # row-major, cutoff_edges_np preserves order)
+    edge_index = np.full((bsz, 2, E), N - 1, np.int32)
     edge_attr = np.zeros((bsz, E, D), dtype)
     edge_mask = np.zeros((bsz, E), dtype)
+    edges_sorted = True
 
     for b, g in enumerate(graphs):
         n = g["loc"].shape[0]
@@ -137,6 +148,9 @@ def pad_graphs(
         loc_mean[b] = g["loc_mean"] if g.get("loc_mean") is not None else g["loc"].mean(axis=0)
         node_mask[b, :n] = 1.0
         edge_index[b, :, :e] = g["edge_index"]
+        if e and (np.any(np.diff(g["edge_index"][0]) < 0)
+                  or g["edge_index"][0][-1] > N - 1):
+            edges_sorted = False
         if D and g.get("edge_attr") is not None:
             edge_attr[b, :e] = g["edge_attr"]
         edge_mask[b, :e] = 1.0
@@ -144,7 +158,7 @@ def pad_graphs(
     return GraphBatch(
         node_feat=node_feat, node_attr=node_attr, loc=loc, vel=vel, target=target,
         loc_mean=loc_mean, node_mask=node_mask, edge_index=edge_index,
-        edge_attr=edge_attr, edge_mask=edge_mask,
+        edge_attr=edge_attr, edge_mask=edge_mask, edges_sorted=edges_sorted,
     )
 
 

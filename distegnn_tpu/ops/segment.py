@@ -16,33 +16,41 @@ from __future__ import annotations
 import jax.numpy as jnp
 
 
-def segment_sum(data, segment_ids, num_segments, mask=None):
+def segment_sum(data, segment_ids, num_segments, mask=None, indices_are_sorted=False):
     """Sum ``data`` rows into ``num_segments`` buckets.
 
     data: [E, ...]; segment_ids: [E] int; mask: optional [E] (0/1 or bool).
     Returns [num_segments, ...]. Masked-out rows contribute nothing (they may
     carry arbitrary ids, e.g. padding pointing at segment 0).
+
+    ``indices_are_sorted=True`` (pad_graphs emits row-sorted edge lists —
+    GraphBatch.edges_sorted) lets XLA use its sorted-scatter lowering.
     """
     if mask is not None:
         m = mask.astype(data.dtype).reshape(mask.shape + (1,) * (data.ndim - 1))
         data = data * m
     out_shape = (num_segments,) + data.shape[1:]
-    return jnp.zeros(out_shape, dtype=data.dtype).at[segment_ids].add(data)
+    return jnp.zeros(out_shape, dtype=data.dtype).at[segment_ids].add(
+        data, indices_are_sorted=indices_are_sorted)
 
 
-def segment_mean(data, segment_ids, num_segments, mask=None):
+def segment_mean(data, segment_ids, num_segments, mask=None, indices_are_sorted=False):
     """Mean of ``data`` rows per segment; empty segments yield 0.
 
     Parity: reference clamps counts to >=1 (models/FastEGNN.py:337) — same
     behavior here via ``maximum(count, 1)``.
     """
-    total = segment_sum(data, segment_ids, num_segments, mask=mask)
+    total = segment_sum(data, segment_ids, num_segments, mask=mask,
+                        indices_are_sorted=indices_are_sorted)
+    # counts accumulate in f32 regardless of data dtype: a bf16 accumulator
+    # saturates at 256 (ulp 2), silently inflating means of degree>=256 nodes
     if mask is None:
-        ones = jnp.ones(data.shape[:1], dtype=data.dtype)
+        ones = jnp.ones(data.shape[:1], dtype=jnp.float32)
     else:
-        ones = mask.astype(data.dtype)
-    count = jnp.zeros((num_segments,), dtype=data.dtype).at[segment_ids].add(ones)
-    count = jnp.maximum(count, 1.0)
+        ones = mask.astype(jnp.float32)
+    count = jnp.zeros((num_segments,), dtype=jnp.float32).at[segment_ids].add(
+        ones, indices_are_sorted=indices_are_sorted)
+    count = jnp.maximum(count, 1.0).astype(data.dtype)
     return total / count.reshape((num_segments,) + (1,) * (data.ndim - 1))
 
 

@@ -1,0 +1,72 @@
+"""Micro-benchmarks for the hot aggregation/matmul primitives at LargeFluid
+shape — decides which segment-op lowering and compute dtype the model uses.
+
+Variants:
+  scatter_unsorted   zeros.at[ids].add(x) with shuffled ids (round-1 behavior)
+  scatter_sorted     same op, ids sorted ascending (what pad_graphs now emits)
+  segsum_flag        jax.ops.segment_sum(indices_are_sorted=True)
+  gather             the read side (x[ids]) for comparison
+  matmul_f32 / bf16  the edge-MLP matmul [E,128]x[128,64]
+"""
+
+from __future__ import annotations
+
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, ".")
+
+E, N, H = 1_639_080, 113_140, 64
+
+
+def timed(fn, *args, warmup=2, steps=10):
+    """block_until_ready alone under-reports on the axon tunnel; force a
+    1-element device->host fetch of the final result instead."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    def sync(o):
+        np.asarray(jnp.ravel(o)[0])
+
+    for _ in range(warmup):
+        out = fn(*args)
+    sync(out)
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        out = fn(*args)
+    sync(out)
+    return (time.perf_counter() - t0) / steps * 1e3
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+
+    rng = np.random.default_rng(0)
+    ids_sorted = np.sort(rng.integers(0, N, size=E)).astype(np.int32)
+    ids_shuf = rng.permutation(ids_sorted).astype(np.int32)
+    x = jnp.asarray(rng.normal(size=(E, H)).astype(np.float32))
+    a = jnp.asarray(rng.normal(size=(E, 2 * H)).astype(np.float32))
+    w = jnp.asarray(rng.normal(size=(2 * H, H)).astype(np.float32))
+    ids_s = jnp.asarray(ids_sorted)
+    ids_u = jnp.asarray(ids_shuf)
+
+    f_scatter = jax.jit(lambda d, i: jnp.zeros((N, H), d.dtype).at[i].add(d))
+    f_segsum_flag = jax.jit(lambda d, i: jax.ops.segment_sum(
+        d, i, num_segments=N, indices_are_sorted=True))
+    f_gather = jax.jit(lambda d, i: d[i[:N]])
+    f_mm = jax.jit(lambda d, k: d @ k)
+    f_mm_bf16 = jax.jit(lambda d, k: (d.astype(jnp.bfloat16) @ k.astype(jnp.bfloat16)).astype(jnp.float32))
+
+    print(f"scatter_unsorted   {timed(f_scatter, x, ids_u):8.2f} ms")
+    print(f"scatter_sorted     {timed(f_scatter, x, ids_s):8.2f} ms")
+    print(f"segsum_flag_sorted {timed(f_segsum_flag, x, ids_s):8.2f} ms")
+    print(f"gather             {timed(f_gather, x, ids_s):8.2f} ms")
+    print(f"matmul_f32         {timed(f_mm, a, w):8.2f} ms")
+    print(f"matmul_bf16        {timed(f_mm_bf16, a, w):8.2f} ms")
+
+
+if __name__ == "__main__":
+    main()

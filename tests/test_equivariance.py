@@ -77,6 +77,55 @@ def test_fastegnn_equivariance_with_padding(rng):
                                np.asarray(out_pad_r[0, :10]), atol=1e-4, rtol=0)
 
 
+def test_fastegnn_bf16_equivariance_and_parity(rng):
+    """compute_dtype='bf16' keeps equivariance structurally exact (geometry
+    stays f32; bf16 touches only invariant-channel MLPs) — tolerance loosened
+    deliberately for bf16 rounding of the invariant inputs. Outputs must also
+    track the f32 model closely (same params)."""
+    kw = dict(node_feat_nf=1, node_attr_nf=0, edge_attr_nf=1, hidden_nf=64,
+              virtual_channels=3, n_layers=4)
+    model32 = FastEGNN(**kw)
+    model16 = FastEGNN(**kw, compute_dtype="bf16")
+    g = _random_graph(rng)
+    R = random_rotate(rng).astype(np.float32)
+    t = (rng.normal(size=(3,)) * 5).astype(np.float32)
+    gb = pad_graphs([g], node_bucket=1, edge_bucket=1)
+    gb_r = pad_graphs([_transform(g, R, t)], node_bucket=1, edge_bucket=1)
+
+    params = model32.init(jax.random.PRNGKey(0), gb)  # same tree for both
+    out32, _ = model32.apply(params, gb)
+    out16, _ = model16.apply(params, gb)
+    out16_r, _ = model16.apply(params, gb_r)
+
+    scale = float(np.abs(np.asarray(out32)).max())
+    np.testing.assert_allclose(np.asarray(out16), np.asarray(out32),
+                               atol=3e-2 * scale, rtol=0)
+    np.testing.assert_allclose(np.asarray(out16[0]) @ R + t, np.asarray(out16_r[0]),
+                               atol=3e-2 * scale, rtol=0)
+
+
+def test_fastegnn_bf16_loss_parity(rng):
+    """Train-step loss under bf16 compute must track f32 (same params/batch)."""
+    from distegnn_tpu.train import TrainState, make_optimizer, make_train_step
+
+    kw = dict(node_feat_nf=1, node_attr_nf=0, edge_attr_nf=1, hidden_nf=32,
+              virtual_channels=3, n_layers=2)
+    g = _random_graph(rng, n=12, e=30)
+    g["target"] = (g["loc"] + 0.1 * g["vel"]).astype(np.float32)
+    gb = pad_graphs([g])
+    losses = {}
+    for name, dt in [("f32", None), ("bf16", "bf16")]:
+        model = FastEGNN(**kw, compute_dtype=dt)
+        params = FastEGNN(**kw).init(jax.random.PRNGKey(0), gb)
+        tx = make_optimizer(1e-3)
+        state = TrainState.create(params, tx)
+        step = jax.jit(make_train_step(model, tx, mmd_weight=0.03, mmd_sigma=1.5,
+                                       mmd_samples=2))
+        state, m = step(state, gb, jax.random.PRNGKey(1))
+        losses[name] = float(m["loss_with_mmd"])
+    assert abs(losses["bf16"] - losses["f32"]) <= 0.05 * abs(losses["f32"]) + 1e-6, losses
+
+
 def test_fastegnn_batched_forward_jits(rng):
     model = FastEGNN(node_feat_nf=2, node_attr_nf=0, edge_attr_nf=1, hidden_nf=16,
                      virtual_channels=2, n_layers=2)

@@ -142,6 +142,53 @@ def test_eval_step_runs(tiny_setup):
     assert np.isfinite(loss) and loss > 0
 
 
+def test_early_stop_checked_every_epoch(tiny_setup, tmp_path):
+    # reference checks the stop condition at the bottom of EVERY epoch
+    # (utils/train.py:261-267), not only on eval epochs: with test_interval=10
+    # and early_stop=3, the run must stop at epoch 3 before any eval happens.
+    from distegnn_tpu.config import ConfigDict
+    from distegnn_tpu.train.trainer import train
+
+    model, params, graphs = tiny_setup
+    tx = make_optimizer(1e-3)
+    state = TrainState.create(params, tx)
+    step = jax.jit(make_train_step(model, tx, mmd_weight=0.0, mmd_sigma=1.0, mmd_samples=1))
+    ev = jax.jit(make_eval_step(model))
+    loader = GraphLoader(GraphDataset(graphs), batch_size=4, shuffle=False, seed=0)
+    config = ConfigDict({
+        "seed": 0,
+        "train": {"epochs": 50, "early_stop": 3},
+        "log": {"test_interval": 10, "log_dir": str(tmp_path), "wandb": {"enable": False}},
+    })
+    _, _, best, log_dict = train(state, step, ev, loader, loader, loader, config, log=False)
+    assert best["early_stop"] == 3
+    assert len(log_dict["loss_train"]) == 3
+
+
+def test_epoch_accumulates_on_device(tiny_setup):
+    # run_epoch_train's average must equal the naive per-step float() average
+    # (it now accumulates the scalar on device, one fetch per epoch)
+    from distegnn_tpu.train.trainer import run_epoch_train
+
+    model, params, graphs = tiny_setup
+    tx = make_optimizer(1e-3)
+    step = jax.jit(make_train_step(model, tx, mmd_weight=0.0, mmd_sigma=1.0, mmd_samples=1))
+    loader = GraphLoader(GraphDataset(graphs), batch_size=4, shuffle=False, seed=0)
+
+    state = TrainState.create(params, tx)
+    _, avg = run_epoch_train(step, state, loader, seed=0, epoch=1)
+
+    state2 = TrainState.create(params, tx)
+    loader.set_epoch(1)
+    total = cnt = 0.0
+    for i, batch in enumerate(loader):
+        key = jax.random.fold_in(jax.random.fold_in(jax.random.PRNGKey(0), 1), i)
+        state2, m = step(state2, batch, key)
+        total += float(m["loss"]) * batch.loc.shape[0]
+        cnt += batch.loc.shape[0]
+    np.testing.assert_allclose(avg, total / cnt, rtol=1e-6)
+
+
 def test_checkpoint_roundtrip(tmp_path, tiny_setup):
     model, params, _ = tiny_setup
     tx = make_optimizer(1e-3, weight_decay=1e-8)

@@ -87,6 +87,10 @@ _DEFAULTS: dict = {
         # padding buckets (TPU-only knobs; static-shape batching):
         "node_bucket": 8,
         "edge_bucket": 128,
+        # mesh data axis (TPU-only): graphs-per-step = batch_size *
+        # data_parallel, sharded over DATA_AXIS; devices used =
+        # world_size * data_parallel (distegnn_tpu/parallel/mesh.py)
+        "data_parallel": 1,
     },
     "train": {
         "learning_rate": 5e-4,
@@ -149,6 +153,8 @@ _CLI_FIELDS = {
     "virtual_channels": ("model.virtual_channels", int),
     "epochs": ("train.epochs", int),
     "world_size": ("data.world_size", int),
+    # TPU-only extension: mesh data axis size (not a reference flag)
+    "data_parallel": ("data.data_parallel", int),
 }
 
 
@@ -166,6 +172,8 @@ def apply_overrides(cfg: ConfigDict, overrides: Mapping) -> None:
     for name, value in overrides.items():
         if value is None:
             continue
+        if name == "multihost":
+            continue  # consumed by main.py before config handling
         if name == "wandb":
             if value:
                 # explicit --wandb means "log online": enable AND go online
@@ -183,6 +191,10 @@ def build_arg_parser() -> argparse.ArgumentParser:
     parser = argparse.ArgumentParser(description="DistEGNN-TPU trainer")
     parser.add_argument("--config_path", type=str, required=True)
     parser.add_argument("--wandb", action="store_true")
+    # multi-host pods: call jax.distributed.initialize() before any backend
+    # use (replaces the reference's torchrun+NCCL process-group init,
+    # main.py:159-163). See docs/MULTIHOST.md.
+    parser.add_argument("--multihost", action="store_true")
     for name, (_, typ) in _CLI_FIELDS.items():
         parser.add_argument(f"--{name}", type=typ, default=None)
     return parser

@@ -101,7 +101,13 @@ class ShardedGraphLoader:
     partition axis [P, B, ...] — the layout shard_map consumes with the P axis
     sharded over the mesh's ``graph`` axis. Mirrors the reference's per-rank
     shard files + identical seeded order (main.py:182-190); shards share one
-    N/E maximum so the stack is rectangular."""
+    N/E maximum so the stack is rectangular.
+
+    ``data_parallel=D`` activates the mesh's second axis: each step draws
+    D*batch_size graphs per partition shard and emits [D, P, B, ...], the D
+    axis sharding over DATA_AXIS (different graphs per data shard — true data
+    parallelism, which the reference lacks: its ranks all see the same batch,
+    SURVEY.md §2.10)."""
 
     def __init__(
         self,
@@ -111,6 +117,7 @@ class ShardedGraphLoader:
         seed: int = 0,
         node_bucket: int = 8,
         edge_bucket: int = 128,
+        data_parallel: int = 1,
     ):
         sizes = {len(d) for d in datasets}
         if len(sizes) != 1:
@@ -118,9 +125,10 @@ class ShardedGraphLoader:
         maxima = [d.size_maxima() for d in datasets]
         n = max(m[0] for m in maxima)
         e = max(m[1] for m in maxima)
+        self.data_parallel = data_parallel
         self.loaders = [
             GraphLoader(
-                d, batch_size, shuffle=shuffle, seed=seed,
+                d, batch_size * data_parallel, shuffle=shuffle, seed=seed,
                 max_nodes=_round_up(n, node_bucket), max_edges=_round_up(e, edge_bucket),
             )
             for d in datasets
@@ -138,5 +146,14 @@ class ShardedGraphLoader:
         return len(self.loaders[0])
 
     def __iter__(self):
+        D = self.data_parallel
         for parts in zip(*self.loaders):
-            yield jax.tree.map(lambda *xs: np.stack(xs, axis=0), *parts)
+            stacked = jax.tree.map(lambda *xs: np.stack(xs, axis=0), *parts)
+            if D > 1:
+                # [P, D*B, ...] -> [D, P, B, ...]
+                stacked = jax.tree.map(
+                    lambda x: x.reshape(x.shape[0], D, x.shape[1] // D,
+                                        *x.shape[2:]).swapaxes(0, 1),
+                    stacked,
+                )
+            yield stacked

@@ -12,8 +12,13 @@ every device applies the identical optimizer update and weights stay
 replicated by construction (the invariant the reference checks with
 broadcast+allclose at startup, main.py:40-55).
 
-Multi-host: call ``jax.distributed.initialize()`` first; the same shard_map
-spans the global mesh and XLA routes the collectives over ICI/DCN.
+Multi-host: ``main.py --multihost`` calls ``jax.distributed.initialize()``;
+``run_distributed`` then builds the mesh from the GLOBAL ``jax.devices()``
+(all processes), host batches become global jax.Arrays via
+``global_batch_putter`` (each host materializes only its addressable shards),
+and the same shard_map spans the global mesh with XLA routing the collectives
+over ICI/DCN. See docs/MULTIHOST.md for the pod launch recipe and
+tests/test_multihost.py for a real two-process CPU test.
 """
 
 from __future__ import annotations
@@ -23,9 +28,9 @@ from typing import Optional
 
 import jax
 import numpy as np
-from jax.sharding import PartitionSpec as P
+from jax.sharding import NamedSharding, PartitionSpec as P
 
-from distegnn_tpu.parallel.mesh import GRAPH_AXIS, make_mesh
+from distegnn_tpu.parallel.mesh import DATA_AXIS, GRAPH_AXIS, make_mesh
 from distegnn_tpu.train import (
     TrainState,
     make_eval_step,
@@ -37,40 +42,97 @@ from distegnn_tpu.train import (
 )
 
 
+def batch_layout(n_data: int):
+    """The single source of truth for the batch array layout: (PartitionSpec
+    for the leading shard axes, per-device strip function). 1-D mesh:
+    [P, B, ...] sharded P(GRAPH_AXIS); 2-D: [D, P, B, ...] sharded
+    P(DATA_AXIS, GRAPH_AXIS)."""
+    if n_data > 1:
+        return P(DATA_AXIS, GRAPH_AXIS), (lambda x: x[0, 0])
+    return P(GRAPH_AXIS), (lambda x: x[0])
+
+
 def make_distributed_steps(model, tx, mesh, mmd_weight: float, mmd_sigma: float,
                            mmd_samples: int):
     """Build jitted (train_step, eval_step) running under shard_map.
 
-    Batch arrays arrive [P, B, ...] (ShardedGraphLoader layout); the leading
-    axis shards over GRAPH_AXIS so each device sees its partition's [B, ...]
-    slice. State and PRNG key are replicated; outputs (replicated state,
-    psum'd scalars) come back as single copies.
+    1-D mesh (data axis size 1): batch arrays arrive [P, B, ...]
+    (ShardedGraphLoader layout); the leading axis shards over GRAPH_AXIS so
+    each device sees its partition's [B, ...] slice.
+
+    2-D mesh: batch arrives [D, P, B, ...]; the leading axes shard over
+    (DATA_AXIS, GRAPH_AXIS). Loss node-weighting and the gradient psum span
+    both axes; the model's virtual-node psums stay on GRAPH_AXIS (the data
+    axis holds different graphs). State and PRNG key are replicated; outputs
+    (replicated state, psum'd scalars) come back as single copies.
     """
+    n_data = mesh.shape[DATA_AXIS]
+    data_axis = DATA_AXIS if n_data > 1 else None
     step = make_train_step(model, tx, mmd_weight=mmd_weight, mmd_sigma=mmd_sigma,
-                           mmd_samples=mmd_samples, axis_name=GRAPH_AXIS)
-    ev = make_eval_step(model, axis_name=GRAPH_AXIS)
+                           mmd_samples=mmd_samples, axis_name=GRAPH_AXIS,
+                           data_axis_name=data_axis)
+    ev = make_eval_step(model, axis_name=GRAPH_AXIS, data_axis_name=data_axis)
+    batch_spec, strip = batch_layout(n_data)
 
     def _step_one(state, batch, key):
-        # strip the leading partition axis (size 1 per device under shard_map)
-        b = jax.tree.map(lambda x: x[0], batch)
+        # strip the leading shard axes (size 1 per device under shard_map)
+        b = jax.tree.map(strip, batch)
         return step(state, b, key)
 
     def _eval_one(params, batch):
-        return ev(params, jax.tree.map(lambda x: x[0], batch))
+        return ev(params, jax.tree.map(strip, batch))
 
     train_step = jax.jit(jax.shard_map(
         _step_one, mesh=mesh,
-        in_specs=(P(), P(GRAPH_AXIS), P()),
+        in_specs=(P(), batch_spec, P()),
         out_specs=(P(), P()),
         check_vma=False,
     ))
     eval_step = jax.jit(jax.shard_map(
         _eval_one, mesh=mesh,
-        in_specs=(P(), P(GRAPH_AXIS)),
+        in_specs=(P(), batch_spec),
         out_specs=P(),
         check_vma=False,
     ))
     return train_step, eval_step
+
+
+def global_batch_putter(mesh):
+    """Host numpy batch -> global jax.Array laid out for make_distributed_steps.
+
+    Single-process this is equivalent to an implicit device_put; multi-host it
+    is REQUIRED: each process holds the full logical batch in host RAM but
+    materializes only its addressable shards (jax.make_array_from_callback
+    invokes the callback per addressable shard index only) — the TPU analog of
+    the reference's per-rank shard files (reference main.py:182-190)."""
+    batch_spec, _ = batch_layout(mesh.shape[DATA_AXIS])
+
+    def put(batch):
+        def _mk(x):
+            x = np.asarray(x)
+            sharding = NamedSharding(mesh, batch_spec)
+            return jax.make_array_from_callback(x.shape, sharding, lambda idx: x[idx])
+
+        return jax.tree.map(_mk, batch)
+
+    return put
+
+
+class _PuttingLoader:
+    """Wrap a loader so every yielded batch goes through global_batch_putter."""
+
+    def __init__(self, loader, put):
+        self.loader, self.put = loader, put
+
+    def set_epoch(self, epoch):
+        self.loader.set_epoch(epoch)
+
+    def __len__(self):
+        return len(self.loader)
+
+    def __iter__(self):
+        for batch in self.loader:
+            yield self.put(batch)
 
 
 def run_distributed(config):
@@ -83,12 +145,19 @@ def run_distributed(config):
     from distegnn_tpu.models.registry import get_model
     from distegnn_tpu.utils.seed import fix_seed
 
-    ws = config.data.get("world_size") or len(jax.devices())
-    if ws > len(jax.devices()):
-        raise ValueError(f"world_size {ws} > available devices {len(jax.devices())}")
+    # world_size = graph partitions (reference semantics); data_parallel adds
+    # the second mesh axis, so ws * dp devices are used. Multi-host: after
+    # jax.distributed.initialize() (main.py --multihost) jax.devices() is the
+    # GLOBAL device list, so the mesh spans all processes with no extra code.
+    dp = int(config.data.get("data_parallel") or 1)
+    ws = config.data.get("world_size") or len(jax.devices()) // dp
+    if ws < 1 or ws * dp > len(jax.devices()):
+        raise ValueError(
+            f"world_size {ws} x data_parallel {dp} does not fit the "
+            f"{len(jax.devices())} available devices")
     derive_runtime_fields(config, world_size=ws)
     fix_seed(config.seed)
-    mesh = make_mesh(n_graph=ws, devices=jax.devices()[:ws])
+    mesh = make_mesh(n_graph=ws, n_data=dp, devices=jax.devices()[:ws * dp])
 
     d = config.data
     name = d.dataset_name
@@ -120,22 +189,27 @@ def run_distributed(config):
     else:
         raise NotImplementedError(f"{name} has no distribute-mode processor")
 
+    put = global_batch_putter(mesh)
     loaders = []
     for split_idx, paths in enumerate(split_paths):
         datasets = [GraphDataset(p) for p in paths]
-        loaders.append(ShardedGraphLoader(
+        loaders.append(_PuttingLoader(ShardedGraphLoader(
             datasets, d.batch_size, shuffle=(split_idx == 0), seed=config.seed,
             node_bucket=d.node_bucket, edge_bucket=d.edge_bucket,
-        ))
+            data_parallel=dp,
+        ), put))
     loader_train, loader_valid, loader_test = loaders
-    print(f"Data ready: {len(loader_train.loaders[0].dataset)} graphs x {ws} partitions")
+    print(f"Data ready: {len(loader_train.loader.loaders[0].dataset)} graphs x "
+          f"{ws} partitions x {dp} data shards")
 
     model = get_model(config.model, world_size=ws, dataset_name=name, axis_name=GRAPH_AXIS)
-    sample = next(iter(loader_train))
-    # init outside shard_map: the axis name is unbound there, and the param
-    # tree is identical either way (axis_name only routes psums)
+    # init outside shard_map on the raw HOST batch (the axis name is unbound
+    # there, and the param tree is identical either way — axis_name only
+    # routes psums); a global jax.Array can't be indexed on one host
+    sample = next(iter(loader_train.loader))
+    _, strip0 = batch_layout(dp)
     params = model.copy(axis_name=None).init(
-        jax.random.PRNGKey(config.seed), jax.tree.map(lambda x: x[0], sample))
+        jax.random.PRNGKey(config.seed), jax.tree.map(strip0, sample))
     n_params = sum(int(np.prod(p.shape)) for p in jax.tree.leaves(params))
     print(f"Model: {config.model.model_name}, {n_params} parameters, mesh graph={ws}")
 

@@ -9,10 +9,13 @@ Axes:
   DATA_AXIS  ('data')  — batch data parallelism; gradient psum once per step.
       May span DCN on multi-host pods.
 
-Multi-host: `jax.distributed.initialize()` + the same code — shard_map over a
-global mesh handles cross-host collectives; there is no rank-conditional code
-anywhere in the framework (rank-0-style work like checkpoint writes keys off
-``jax.process_index() == 0``).
+Multi-host: ``main.py --multihost`` calls jax.distributed.initialize(), then
+this same code builds the mesh from the GLOBAL jax.devices() — shard_map over
+a global mesh handles cross-host collectives; there is no rank-conditional
+code anywhere in the framework (rank-0-style work like checkpoint writes keys
+off ``jax.process_index() == 0``). Exercised for real by
+tests/test_multihost.py (two OS processes, 8-device world, gloo CPU
+collectives); pod recipe in docs/MULTIHOST.md.
 """
 
 from __future__ import annotations

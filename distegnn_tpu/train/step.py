@@ -86,28 +86,45 @@ def make_optimizer(
     return tx
 
 
+def _reduce_axes(axis_name, data_axis_name):
+    """All mesh axes the LOSS/GRADIENT reduce over: graph partitions and (when
+    2-D) data-parallel shards. The model's virtual-node psums stay on
+    ``axis_name`` alone — virtual nodes are per-graph objects, and the data
+    axis holds *different* graphs."""
+    axes = tuple(a for a in (data_axis_name, axis_name) if a is not None)
+    return axes if axes else None
+
+
 def make_loss_fn(model, mmd_weight: float, mmd_sigma: float, mmd_samples: int,
-                 axis_name: Optional[str] = None) -> Callable:
+                 axis_name: Optional[str] = None,
+                 data_axis_name: Optional[str] = None) -> Callable:
     """loss(params, batch, key) -> (local_loss_for_grad, logged_global_mse).
 
     The grad path carries only THIS partition's weighted share; the train step
-    psums the resulting parameter gradients across the axis (DDP-sum pattern —
+    psums the resulting parameter gradients across the mesh (DDP-sum pattern —
     differentiating the psum'd global loss instead would scale gradients by
     the axis size, since psum's transpose is psum). logged_global_mse is the
-    node-weighted global MSE the reference logs (total_loss_loc)."""
+    node-weighted global MSE the reference logs (total_loss_loc).
+
+    With a 2-D (data x graph) mesh the node-count weighting spans BOTH axes:
+    every device holds a partition of some graph of the global batch, and the
+    global loss is the node-weighted sum over all of them — the natural
+    generalization of reference utils/train.py:100-110, where the data axis is
+    degenerate (every rank sees the same graphs)."""
+    axes = _reduce_axes(axis_name, data_axis_name)
 
     def loss_fn(params, batch: GraphBatch, key):
         loc_pred, virtual_loc = model.apply(params, batch)
         mse_local = masked_mse(loc_pred, batch.target, batch.node_mask)
-        loss = weighted_local_loss(mse_local, batch.node_mask, axis_name)
-        logged = _psum(loss, axis_name)
+        loss = weighted_local_loss(mse_local, batch.node_mask, axes)
+        logged = _psum(loss, axes)
         if mmd_weight:
-            if axis_name is not None:
-                # independent sample draw per partition (each rank samples its
+            for a in axes or ():
+                # independent sample draw per device (each rank samples its
                 # own local nodes, reference utils/train.py:124-139)
-                key = jax.random.fold_in(key, jax.lax.axis_index(axis_name))
+                key = jax.random.fold_in(key, jax.lax.axis_index(a))
             lm = mmd_loss(virtual_loc, batch.target, batch.node_mask, key, mmd_sigma, mmd_samples)
-            loss = loss + mmd_weight * weighted_local_loss(lm, batch.node_mask, axis_name)
+            loss = loss + mmd_weight * weighted_local_loss(lm, batch.node_mask, axes)
         return loss, logged
 
     return loss_fn
@@ -115,33 +132,39 @@ def make_loss_fn(model, mmd_weight: float, mmd_sigma: float, mmd_samples: int,
 
 def make_train_step(model, tx: optax.GradientTransformation, mmd_weight: float,
                     mmd_sigma: float, mmd_samples: int,
-                    axis_name: Optional[str] = None) -> Callable:
+                    axis_name: Optional[str] = None,
+                    data_axis_name: Optional[str] = None) -> Callable:
     """Returns step(state, batch, key) -> (state, metrics). Jit/shard_map it."""
-    loss_fn = make_loss_fn(model, mmd_weight, mmd_sigma, mmd_samples, axis_name)
+    loss_fn = make_loss_fn(model, mmd_weight, mmd_sigma, mmd_samples,
+                           axis_name, data_axis_name)
+    axes = _reduce_axes(axis_name, data_axis_name)
 
     def step(state: TrainState, batch: GraphBatch, key):
         (loss, logged), grads = jax.value_and_grad(loss_fn, has_aux=True)(state.params, batch, key)
-        if axis_name is not None:
-            # DDP-style gradient sum: each device holds the gradient of ITS
-            # partition's loss share (incl. cross-device terms routed through
-            # the model's virtual-node psums); summing yields the exact global
-            # gradient, identically on every device — weights stay replicated.
-            grads = jax.lax.psum(grads, axis_name)
+        if axes is not None:
+            # DDP-style gradient sum over the WHOLE mesh: each device holds
+            # the gradient of ITS shard's loss share (incl. cross-device terms
+            # routed through the model's virtual-node psums); summing yields
+            # the exact global gradient, identically on every device — weights
+            # stay replicated.
+            grads = jax.lax.psum(grads, axes)
         updates, opt_state = tx.update(grads, state.opt_state, state.params)
         params = optax.apply_updates(state.params, updates)
         new_state = TrainState(params=params, opt_state=opt_state, step=state.step + 1)
-        return new_state, {"loss": logged, "loss_with_mmd": _psum(loss, axis_name)}
+        return new_state, {"loss": logged, "loss_with_mmd": _psum(loss, axes)}
 
     return step
 
 
-def make_eval_step(model, axis_name: Optional[str] = None) -> Callable:
+def make_eval_step(model, axis_name: Optional[str] = None,
+                   data_axis_name: Optional[str] = None) -> Callable:
     """Returns eval(params, batch) -> node-weighted global MSE (no MMD —
     reference eval epochs compute only total_loss_loc)."""
+    axes = _reduce_axes(axis_name, data_axis_name)
 
     def eval_step(params, batch: GraphBatch):
         loc_pred, _ = model.apply(params, batch)
         mse_local = masked_mse(loc_pred, batch.target, batch.node_mask)
-        return weighted_global_loss(mse_local, batch.node_mask, axis_name)
+        return weighted_global_loss(mse_local, batch.node_mask, axes)
 
     return eval_step

@@ -36,6 +36,28 @@ def count_parameters(params) -> int:
     return sum(int(np.prod(p.shape)) for p in jax.tree.leaves(params))
 
 
+def init_multihost():
+    """Join the multi-host world BEFORE any backend use — the TPU replacement
+    for the reference's NCCL process-group init (reference main.py:159-163).
+
+    On TPU pods jax.distributed.initialize() auto-discovers coordinator, rank
+    and world size from the pod metadata. Elsewhere (e.g. CPU test rigs) pass
+    them via DISTEGNN_COORD / DISTEGNN_NPROC / DISTEGNN_PID env vars. After
+    this, jax.devices() is the GLOBAL device list, jax.process_index() plays
+    the reference's `rank`, and the same shard_map code spans all hosts."""
+    coord = os.environ.get("DISTEGNN_COORD")
+    if coord:
+        jax.distributed.initialize(
+            coordinator_address=coord,
+            num_processes=int(os.environ["DISTEGNN_NPROC"]),
+            process_id=int(os.environ["DISTEGNN_PID"]),
+        )
+    else:
+        jax.distributed.initialize()
+    print(f"multihost: process {jax.process_index()}/{jax.process_count()}, "
+          f"{len(jax.local_devices())} local / {len(jax.devices())} global devices")
+
+
 def process_dataset_edge_cutoff(data_cfg, seed: int = 0):
     """Dispatch by dataset (reference process_dataset_edge_cutoff,
     datasets/process_dataset.py:32-45)."""
@@ -73,6 +95,8 @@ def process_dataset_edge_cutoff(data_cfg, seed: int = 0):
 def main(argv=None):
     parser = build_arg_parser()
     args = parser.parse_args(argv)
+    if getattr(args, "multihost", False):
+        init_multihost()
     overrides = {k: v for k, v in vars(args).items() if k != "config_path"}
     config = load_config(args.config_path, overrides=overrides)
 

@@ -1,0 +1,99 @@
+"""Worker for the two-process multi-host test (run via subprocess by
+tests/test_multihost.py, or imported for its problem builder).
+
+Reproduces the reference's multi-process execution model (one process per
+device group, reference main.py:159-163 NCCL init) the JAX way:
+`jax.distributed.initialize(coordinator, num_processes, process_id)` on a CPU
+backend with 4 local virtual devices per process -> 8 global devices, then the
+SAME run_distributed machinery (global mesh, global_batch_putter, shard_map
+step) as single-process. Deterministic by construction, so the parent can
+compare its single-process result bit-for-bit-ish (rtol 1e-6).
+"""
+
+from __future__ import annotations
+
+import sys
+
+import numpy as np
+
+DP, NPART = 2, 4  # 2 data shards x 4 graph partitions = 8 devices
+STEPS = 2
+
+
+def build_problem():
+    """[D, P, B=1, ...] batch for a deterministic 2-graph 4-partition task."""
+    import jax
+
+    from distegnn_tpu.data import build_nbody_graph
+    from distegnn_tpu.data.partition import split_graph
+    from distegnn_tpu.ops.graph import pad_graphs
+
+    rng = np.random.default_rng(11)
+    per_d = []
+    for d in range(DP):
+        n = 24
+        loc = rng.normal(size=(n, 3))
+        vel = rng.normal(size=(n, 3))
+        charges = rng.choice([1.0, -1.0], size=(n, 1))
+        g = build_nbody_graph(loc, vel, charges, loc + 0.1 * vel, radius=-1.0)
+        per_d.append(split_graph(g, NPART, "random", inner_radius=2.5, seed=5))
+    n_max = max(p["loc"].shape[0] for parts in per_d for p in parts)
+    e_max = max(p["edge_index"].shape[1] for parts in per_d for p in parts)
+    stacks = []
+    for parts in per_d:
+        pbs = [pad_graphs([p], max_nodes=n_max + 2, max_edges=e_max + 8) for p in parts]
+        stacks.append(jax.tree.map(lambda *xs: np.stack(xs, axis=0), *pbs))
+    return jax.tree.map(lambda *xs: np.stack(xs, axis=0), *stacks)
+
+
+def run():
+    """Build the global mesh over ALL devices (local or cross-process), run
+    STEPS train steps + one eval. Returns (train_loss, eval_loss) floats —
+    identical on every process because state is replicated."""
+    import jax
+
+    from distegnn_tpu.models.fast_egnn import FastEGNN
+    from distegnn_tpu.parallel.launch import global_batch_putter, make_distributed_steps
+    from distegnn_tpu.parallel.mesh import GRAPH_AXIS, make_mesh
+    from distegnn_tpu.train import TrainState, make_optimizer
+
+    batch = build_problem()
+    mesh = make_mesh(n_graph=NPART, n_data=DP, devices=jax.devices())
+    model = FastEGNN(node_feat_nf=2, edge_attr_nf=2, hidden_nf=16,
+                     virtual_channels=3, n_layers=2, axis_name=GRAPH_AXIS)
+    params = model.copy(axis_name=None).init(
+        jax.random.PRNGKey(0), jax.tree.map(lambda x: x[0, 0], batch))
+    tx = make_optimizer(1e-3)
+    state = TrainState.create(params, tx)
+    train_step, eval_step = make_distributed_steps(
+        model, tx, mesh, mmd_weight=0.03, mmd_sigma=1.5, mmd_samples=2)
+
+    gb = global_batch_putter(mesh)(batch)
+    for i in range(STEPS):
+        state, metrics = train_step(state, gb, jax.random.PRNGKey(3 + i))
+    return float(metrics["loss"]), float(eval_step(state.params, gb))
+
+
+def main():
+    import os
+
+    port, pid = sys.argv[1], int(sys.argv[2])
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+
+    import jax
+
+    # CPU cross-process collectives need the gloo transport; without it (and
+    # with any extra PJRT plugin on PYTHONPATH) initialize() can hang — the
+    # parent test also strips the TPU plugin path from the env.
+    jax.config.update("jax_cpu_collectives_implementation", "gloo")
+    jax.distributed.initialize(coordinator_address=f"localhost:{port}",
+                               num_processes=2, process_id=pid)
+    assert len(jax.devices()) == 8, jax.devices()
+    assert len(jax.local_devices()) == 4
+    loss, ev = run()
+    print(f"RESULT {pid} {loss:.10f} {ev:.10f}", flush=True)
+
+
+if __name__ == "__main__":
+    main()

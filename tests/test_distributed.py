@@ -148,6 +148,72 @@ def test_distributed_loss_and_grads_match_union(dist_setup):
                                float(ev_1(params, union_batch)), rtol=1e-5)
 
 
+def test_2d_mesh_matches_single_device(rng):
+    """(data=2, graph=2) mesh: 2 different graphs, each split into 2 spatial
+    partitions, one partition per device. One SGD step must equal the
+    single-device step on the padded 2-graph union batch (VERDICT r1 item 4:
+    the data axis in actual use). MMD off for exactness (its sample draw is
+    per-device by design, reference utils/train.py:124-139)."""
+    import optax
+
+    from distegnn_tpu.parallel.mesh import DATA_AXIS
+
+    D = Pn = 2
+    graphs, unions, per_d = [], [], []
+    for d in range(D):
+        g = _graph(rng, n=20 + 4 * d)
+        parts = split_graph(g, Pn, "random", inner_radius=2.5, outer_radius=None, seed=d)
+        per_d.append(parts)
+        unions.append(_union_of_parts(parts))
+    n_max = max(p["loc"].shape[0] for parts in per_d for p in parts)
+    e_max = max(p["edge_index"].shape[1] for parts in per_d for p in parts)
+    stacks = []
+    for parts in per_d:
+        pbs = [pad_graphs([p], max_nodes=n_max + 2, max_edges=e_max + 8) for p in parts]
+        stacks.append(jax.tree.map(lambda *xs: np.stack(xs, axis=0), *pbs))
+    batch_2d = jax.tree.map(lambda *xs: np.stack(xs, axis=0), *stacks)  # [D, P, 1, ...]
+
+    union_batch = pad_graphs(unions)  # [2, N, ...] — both graphs in one batch
+
+    model_1 = FastEGNN(node_feat_nf=2, hidden_nf=16, virtual_channels=3, n_layers=3)
+    model_P = model_1.copy(axis_name=GRAPH_AXIS)
+    params = model_1.init(jax.random.PRNGKey(0), union_batch)
+    tx = optax.sgd(1e-2)
+
+    mesh = make_mesh(n_graph=Pn, n_data=D, devices=jax.devices()[:4])
+    train_P, eval_P = make_distributed_steps(model_P, tx, mesh, mmd_weight=0.0,
+                                             mmd_sigma=1.5, mmd_samples=2)
+    step_1 = jax.jit(make_train_step(model_1, tx, mmd_weight=0.0, mmd_sigma=1.5,
+                                     mmd_samples=2))
+
+    key = jax.random.PRNGKey(9)
+    s1, m1 = step_1(TrainState.create(params, tx), union_batch, key)
+    sP, mP = train_P(TrainState.create(params, tx), batch_2d, key)
+
+    np.testing.assert_allclose(float(mP["loss"]), float(m1["loss"]), rtol=1e-5)
+    for a, b in zip(jax.tree.leaves(s1.params), jax.tree.leaves(sP.params)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-5)
+
+    ev_1 = jax.jit(make_eval_step(model_1))
+    np.testing.assert_allclose(float(eval_P(params, batch_2d)),
+                               float(ev_1(params, union_batch)), rtol=1e-5)
+
+
+def test_sharded_loader_data_parallel_layout(rng):
+    """data_parallel=D splits each partition shard's draw into [D, P, B, ...]
+    with consecutive graphs of the seeded order going to consecutive data
+    shards."""
+    parts = [_graph(rng, n=8) for _ in range(2)]
+    shards = [GraphDataset([p] * 4) for p in parts]  # P=2 shards, 4 graphs each
+    flat = ShardedGraphLoader(shards, batch_size=4, shuffle=False, seed=0)
+    dp = ShardedGraphLoader(shards, batch_size=2, shuffle=False, seed=0, data_parallel=2)
+    (b_flat,), (b_dp,) = list(flat), list(dp)
+    assert b_dp.loc.shape[:3] == (2, 2, 2)  # [D, P, B]
+    # graph g of shard p at flat position [p, d*B+b] lands at [d, p, b]
+    np.testing.assert_array_equal(b_dp.loc[1, 0, 1], b_flat.loc[0, 3])
+    np.testing.assert_array_equal(b_dp.loc[0, 1, 0], b_flat.loc[1, 0])
+
+
 def test_sharded_loader_with_distributed_step(dist_setup):
     model_1, model_P, params, _, _, mesh, parts = dist_setup
     # loaders over P shards (each shard = a dataset of one partition per graph)

@@ -55,8 +55,11 @@ def write_partitioned_split(
     assert len({len(s) for s in shards}) == 1, "unequal shard lengths"
     os.makedirs(processed_dir, exist_ok=True)
     for p, path in enumerate(paths):
-        with open(path, "wb") as f:
+        # tmp + atomic rename: a reader (another host on shared storage, or a
+        # crashed run's leftovers) never sees a truncated pickle
+        with open(path + ".tmp", "wb") as f:
             pickle.dump(shards[p], f, protocol=pickle.HIGHEST_PROTOCOL)
+        os.replace(path + ".tmp", path)
     return paths
 
 

@@ -59,10 +59,51 @@ def read_sim(data_dir: str, dataset_name: str, idx: int):
         for frame in raw:
             position.append(np.asarray(frame["pos"]))
             vel.append(np.asarray(frame["vel"]))
-        viscosity = np.asarray(raw[0]["viscosity"])
-        mass = np.asarray(raw[0]["m"])
+        if raw:  # tolerate empty shards (short simulations)
+            viscosity = np.asarray(raw[0]["viscosity"])
+            mass = np.asarray(raw[0]["m"])
     return (np.stack(position).astype(np.float32), np.stack(vel).astype(np.float32),
             viscosity.astype(np.float32), mass.astype(np.float32))
+
+
+def write_fluid_sim(data_dir: str, dataset_name: str, idx: int,
+                    pos: np.ndarray, vel: np.ndarray,
+                    viscosity: np.ndarray, mass: np.ndarray) -> None:
+    """Write one simulation in the exact on-disk format ``read_sim`` consumes
+    (16 zstd+msgpack shards with msgpack-numpy array encoding — the layout of
+    reference dataset_generation/Fluid113K/create_physics_records.py:1-148).
+
+    pos/vel: [T, N, 3]; T frames are split evenly over the 16 shards. Used by
+    scripts/generate_fluid_synthetic.py (format-identical synthetic data for
+    pipeline validation at any scale) and the end-to-end tests; real
+    SPlisHSPlasH data is the supported production path (docs/DATASETS.md)."""
+    import msgpack
+    import zstandard as zstd
+
+    def encode_np(o):
+        if isinstance(o, np.ndarray):
+            return {b"nd": True, b"type": o.dtype.str.encode(),
+                    b"shape": list(o.shape), b"data": o.tobytes()}
+        return o
+
+    base = os.path.join(data_dir, dataset_name)
+    os.makedirs(base, exist_ok=True)
+    T = pos.shape[0]
+    # np.array_split balance: every shard non-empty for T >= SHARDS_PER_SIM
+    bounds = np.linspace(0, T, SHARDS_PER_SIM + 1).astype(int)
+    cctx = zstd.ZstdCompressor()
+    viscosity = np.asarray(viscosity, np.float32)
+    mass = np.asarray(mass, np.float32)
+    for s in range(SHARDS_PER_SIM):
+        frames = [
+            {"pos": np.asarray(pos[t], np.float32),
+             "vel": np.asarray(vel[t], np.float32),
+             "viscosity": viscosity, "m": mass}
+            for t in range(bounds[s], bounds[s + 1])
+        ]
+        packed = msgpack.packb(frames, default=encode_np)
+        with open(os.path.join(base, f"sim_{idx:04d}_{s:02d}.msgpack.zst"), "wb") as f:
+            f.write(cctx.compress(packed))
 
 
 def build_fluid_graph(loc_0, vel_0, viscosity, mass, target) -> dict:

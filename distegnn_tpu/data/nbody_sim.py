@@ -274,6 +274,108 @@ def simulate_trajectory(
             return np.asarray(loc), np.asarray(vel), sys_.charges.copy(), sys_.edges.copy()
 
 
+def simulate_trajectories_batched(
+    rng: np.random.Generator,
+    num: int,
+    length: int,
+    sample_freq: int,
+    n_isolated: int,
+    clusters: int = 1,
+    delta_t: float = 0.001,
+    loc_std: float = 1.0,
+    vel_norm: float = 0.5,
+    interaction_strength: float = 1.0,
+    charge_types=(1.0, -1.0),
+    dtype: str = "float64",
+):
+    """Batched isolated-only fast path: ``num`` trajectories integrated at
+    once with one jitted lax.scan (any backend; ~2 orders of magnitude over
+    the per-trajectory Python loop on a single host core).
+
+    Same physics as ChargedSystem (reference system.py:16,107-135): softened
+    Coulomb forces elementwise-clipped to +-0.1/dt, symplectic Euler, samples
+    at t % sample_freq == 0 of the reference's step loop
+    (generate_dataset.py:55-70) — i.e. one step, sample, then
+    (sample_freq steps, sample) x (T-1); the reference's trailing
+    sample_freq-1 unsampled steps are skipped. RNG draws differ in ORDER from
+    the serial path, so a given seed yields a statistically identical but not
+    bitwise-equal dataset; constraints (sticks/hinges) and box_size need the
+    serial path.
+
+    ``dtype``: 'float64' (default; the serial path's precision — integrated
+    under jax's local enable_x64 so no global config leaks) or 'float32'
+    (half the memory/time; fine for training data, which the pipelines cast
+    to f32 anyway, but 5000 chaotic Coulomb steps DIVERGE pointwise from an
+    f64 integration — only the distribution matches). TPU backends have no
+    native f64; use float32 there.
+
+    Returns (loc [num,T,N,3], vel [num,T,N,3], charges [num,N,1],
+    edges [num,N,N]); loc/vel in ``dtype``.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    n = n_isolated
+    T = (length + sample_freq - 1) // sample_freq
+    max_F = 0.1 / delta_t
+    std = loc_std * (float(n) / 5.0) ** (1.0 / 3.0) + 0.1
+
+    charges = rng.choice(np.asarray(charge_types, float), size=(num, n, 1))
+    edges = charges @ np.swapaxes(charges, 1, 2)
+    if clusters == 1:
+        centers = np.zeros((num, 1, 3))
+    else:
+        scale = 10.0 * clusters if clusters == 3 else 3.0 * clusters
+        centers = rng.uniform(-scale, scale, size=(num, clusters, 3))
+    which = rng.integers(0, clusters, size=(num, n))
+    X0 = rng.standard_normal((num, n, 3)) * std + np.take_along_axis(
+        centers, which[:, :, None], axis=1)
+    V0 = rng.standard_normal((num, n, 3))
+    V0 = V0 / np.linalg.norm(V0, axis=2, keepdims=True) * vel_norm
+
+    eye = jnp.eye(n, dtype=bool)
+
+    def force(X, E):
+        diff = X[:, :, None, :] - X[:, None, :, :]
+        r2 = jnp.sum(diff * diff, axis=-1)
+        r2 = jnp.where(eye, jnp.inf, r2)
+        k = interaction_strength * E / jnp.power(r2, 1.5)
+        F = jnp.einsum("bij,bijd->bid", k, diff)
+        return jnp.clip(F, -max_F, max_F)
+
+    def one_step(carry):
+        X, V, E = carry
+        F = force(X, E)
+        V = V + F * delta_t
+        X = X + V * delta_t
+        return X, V, E
+
+    @jax.jit
+    def run(X, V, E):
+        def sample_block(carry, _):
+            carry = jax.lax.fori_loop(0, sample_freq, lambda _, c: one_step(c), carry)
+            return carry, (carry[0], carry[1])
+
+        carry = one_step((X, V, E))  # reference samples first at t == 0, after one step
+        first = (carry[0], carry[1])
+        _, rest = jax.lax.scan(sample_block, carry, None, length=T - 1)
+        loc = jnp.concatenate([first[0][None], rest[0]], axis=0)
+        vel = jnp.concatenate([first[1][None], rest[1]], axis=0)
+        return jnp.swapaxes(loc, 0, 1), jnp.swapaxes(vel, 0, 1)  # [num, T, N, 3]
+
+    if dtype == "float64":
+        with jax.experimental.enable_x64():
+            loc, vel = run(jnp.asarray(X0, jnp.float64),
+                           jnp.asarray(V0, jnp.float64),
+                           jnp.asarray(edges, jnp.float64))
+            loc, vel = np.asarray(loc), np.asarray(vel)
+    else:
+        loc, vel = run(jnp.asarray(X0, jnp.float32), jnp.asarray(V0, jnp.float32),
+                       jnp.asarray(edges, jnp.float32))
+        loc, vel = np.asarray(loc), np.asarray(vel)
+    return loc, vel, charges, edges
+
+
 def generate_nbody_files(
     path: str,
     n_isolated: int = 0,
@@ -296,19 +398,38 @@ def generate_nbody_files(
     tag = f"charged{n_isolated}_{n_stick}_{n_hinge}_{clusters}{suffix}"
     os.makedirs(path, exist_ok=True)
     rng = np.random.default_rng(seed)
+    fast = n_stick == 0 and n_hinge == 0 and box_size is None
     for split, num in (("train", num_train), ("valid", num_valid), ("test", num_test)):
-        locs, vels, chgs, edgs = [], [], [], []
-        for _ in range(num):
-            loc, vel, charges, edges = simulate_trajectory(
-                rng, length, sample_freq, n_isolated=n_isolated, n_stick=n_stick,
-                n_hinge=n_hinge, clusters=clusters, box_size=box_size,
-            )
-            locs.append(loc)
-            vels.append(vel)
-            chgs.append(charges)
-            edgs.append(edges)
-        np.save(os.path.join(path, f"loc_{split}_{tag}.npy"), np.asarray(locs))
-        np.save(os.path.join(path, f"vel_{split}_{tag}.npy"), np.asarray(vels))
-        np.save(os.path.join(path, f"charges_{split}_{tag}.npy"), np.asarray(chgs))
-        np.save(os.path.join(path, f"edges_{split}_{tag}.npy"), np.asarray(edgs))
+        if fast and num:
+            # accelerator-friendly batched integrator, chunked to bound memory
+            locs, vels, chgs, edgs = [], [], [], []
+            chunk = 512
+            for at in range(0, num, chunk):
+                loc, vel, charges, edges = simulate_trajectories_batched(
+                    rng, min(chunk, num - at), length, sample_freq,
+                    n_isolated=n_isolated, clusters=clusters,
+                )
+                locs.append(loc)
+                vels.append(vel)
+                chgs.append(charges)
+                edgs.append(edges)
+            locs, vels = np.concatenate(locs), np.concatenate(vels)
+            chgs, edgs = np.concatenate(chgs), np.concatenate(edgs)
+        else:
+            locs, vels, chgs, edgs = [], [], [], []
+            for _ in range(num):
+                loc, vel, charges, edges = simulate_trajectory(
+                    rng, length, sample_freq, n_isolated=n_isolated, n_stick=n_stick,
+                    n_hinge=n_hinge, clusters=clusters, box_size=box_size,
+                )
+                locs.append(loc)
+                vels.append(vel)
+                chgs.append(charges)
+                edgs.append(edges)
+            locs, vels = np.asarray(locs), np.asarray(vels)
+            chgs, edgs = np.asarray(chgs), np.asarray(edgs)
+        np.save(os.path.join(path, f"loc_{split}_{tag}.npy"), locs)
+        np.save(os.path.join(path, f"vel_{split}_{tag}.npy"), vels)
+        np.save(os.path.join(path, f"charges_{split}_{tag}.npy"), chgs)
+        np.save(os.path.join(path, f"edges_{split}_{tag}.npy"), edgs)
     return tag

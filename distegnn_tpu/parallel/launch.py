@@ -135,13 +135,49 @@ class _PuttingLoader:
             yield self.put(batch)
 
 
+def _dispatch_preprocess(config, ws: int):
+    """Per-dataset distribute-mode preprocessing (reference
+    process_dataset_distribute, datasets/process_dataset.py:48-58). Idempotent:
+    results are cached shard files keyed by config, so any process may call it
+    and later callers hit the cache."""
+    from distegnn_tpu.data.distribute import process_nbody_distribute
+
+    d = config.data
+    name = d.dataset_name
+    if name.startswith("nbody"):
+        return process_nbody_distribute(
+            d.data_dir, name, ws, d.max_samples, d.inner_radius, d.outer_radius,
+            d.split_mode, d.frame_0, d.frame_T, seed=config.seed,
+        )
+    if name == "Water-3D":
+        try:
+            from distegnn_tpu.data.water3d import process_water3d_distribute
+        except ImportError as e:
+            raise NotImplementedError("Water-3D pipeline not built yet (SURVEY.md §7.2 stage 8)") from e
+
+        return process_water3d_distribute(
+            d.data_dir, name, ws, d.max_samples, d.inner_radius, d.outer_radius,
+            d.split_mode, d.delta_t, seed=config.seed,
+        )
+    if name in ("Fluid113K", "LargeFluid"):
+        try:
+            from distegnn_tpu.data.fluid113k import process_large_fluid_distribute
+        except ImportError as e:
+            raise NotImplementedError("Fluid113K pipeline not built yet (SURVEY.md §7.2 stage 8)") from e
+
+        return process_large_fluid_distribute(
+            d.data_dir, name, ws, d.max_samples, d.inner_radius, d.outer_radius,
+            d.split_mode, d.delta_t, seed=config.seed,
+        )
+    raise NotImplementedError(f"{name} has no distribute-mode processor")
+
+
 def run_distributed(config):
     """Distribute-mode entry (reference main.py distribute flow): partitioned
     shards -> ShardedGraphLoader -> shard_map'd jitted step -> shared outer
     training loop."""
     from distegnn_tpu.config import derive_runtime_fields
     from distegnn_tpu.data import GraphDataset, ShardedGraphLoader
-    from distegnn_tpu.data.distribute import process_nbody_distribute
     from distegnn_tpu.models.registry import get_model
     from distegnn_tpu.utils.seed import fix_seed
 
@@ -161,33 +197,23 @@ def run_distributed(config):
 
     d = config.data
     name = d.dataset_name
-    if name.startswith("nbody"):
-        split_paths = process_nbody_distribute(
-            d.data_dir, name, ws, d.max_samples, d.inner_radius, d.outer_radius,
-            d.split_mode, d.frame_0, d.frame_T, seed=config.seed,
-        )
-    elif name == "Water-3D":
-        try:
-            from distegnn_tpu.data.water3d import process_water3d_distribute
-        except ImportError as e:
-            raise NotImplementedError("Water-3D pipeline not built yet (SURVEY.md §7.2 stage 8)") from e
 
-        split_paths = process_water3d_distribute(
-            d.data_dir, name, ws, d.max_samples, d.inner_radius, d.outer_radius,
-            d.split_mode, d.delta_t, seed=config.seed,
-        )
-    elif name in ("Fluid113K", "LargeFluid"):
-        try:
-            from distegnn_tpu.data.fluid113k import process_large_fluid_distribute
-        except ImportError as e:
-            raise NotImplementedError("Fluid113K pipeline not built yet (SURVEY.md §7.2 stage 8)") from e
+    def preprocess():
+        return _dispatch_preprocess(config, ws)
 
-        split_paths = process_large_fluid_distribute(
-            d.data_dir, name, ws, d.max_samples, d.inner_radius, d.outer_radius,
-            d.split_mode, d.delta_t, seed=config.seed,
-        )
+    if jax.process_count() > 1:
+        # preprocessing runs on process 0 only, everyone else waits at a
+        # barrier then reads the cache — the reference's rank-0 + dist.barrier
+        # flow (reference main.py:171-177, process_dataset.py:462-463)
+        from jax.experimental import multihost_utils
+
+        if jax.process_index() == 0:
+            split_paths = preprocess()
+        multihost_utils.sync_global_devices("distegnn_preprocess")
+        if jax.process_index() != 0:
+            split_paths = preprocess()  # cache hit: shard files exist
     else:
-        raise NotImplementedError(f"{name} has no distribute-mode processor")
+        split_paths = preprocess()
 
     put = global_batch_putter(mesh)
     loaders = []

@@ -1,0 +1,70 @@
+"""End-to-end LargeFluid distribute run (VERDICT r1 item 5): the REAL
+configs/largefluid_distegnn.yaml through run_distributed — synthetic
+Fluid113K-format shards at moderate scale, METIS partitioning with uneven
+partition sizes, ShardedGraphLoader, grad accumulation (4), MMD, 8-device
+CPU mesh, >= 2 epochs. Mirrors the reference distribute flow
+(datasets/process_dataset.py:441-578 + utils/train.py)."""
+
+from __future__ import annotations
+
+import os
+
+import numpy as np
+import pytest
+
+N_PART = 1200
+RADIUS = 0.16
+
+
+@pytest.fixture(scope="module")
+def fluid_dataset(tmp_path_factory):
+    from distegnn_tpu.data.fluid113k import SIM_SPLITS, write_fluid_sim
+    from scripts.generate_fluid_synthetic import synth_sim
+
+    rng = np.random.default_rng(3)
+    d = str(tmp_path_factory.mktemp("largefluid"))
+    for split, (lo, _) in SIM_SPLITS.items():
+        pos, vel = synth_sim(rng, N_PART, 26, RADIUS)
+        write_fluid_sim(d, "Fluid113K", lo, pos, vel,
+                        np.full((N_PART,), 0.01, np.float32),
+                        np.full((N_PART,), 0.1, np.float32))
+    return d
+
+
+@pytest.mark.slow
+def test_largefluid_yaml_runs_distributed_metis(fluid_dataset, tmp_path):
+    from distegnn_tpu.config import load_config
+    from distegnn_tpu.data import GraphDataset
+    from distegnn_tpu.parallel.launch import run_distributed
+
+    config = load_config(os.path.join(os.path.dirname(__file__), "..",
+                                      "configs", "largefluid_distegnn.yaml"))
+    config.data.data_dir = fluid_dataset
+    config.data.max_samples = 3
+    config.data.world_size = 8
+    config.data.outer_radius = RADIUS   # scaled for N_PART density
+    config.data.inner_radius = RADIUS
+    config.data.delta_t = 3
+    config.train.epochs = 2
+    config.log.log_dir = str(tmp_path)
+    assert config.data.split_mode == "metis"           # the yaml's real value
+    assert config.train.accumulation_steps == 4        # exercises MultiSteps
+
+    best = run_distributed(config)
+    assert np.isfinite(best["loss_valid"]) and np.isfinite(best["loss_test"])
+
+    # the metis shards really are uneven: partition node counts differ
+    processed = os.path.join(fluid_dataset, "Fluid113K", "processed")
+    shard_files = sorted(f for f in os.listdir(processed) if "_train_" in f)
+    assert len(shard_files) == 8
+    counts = []
+    for f in shard_files:
+        ds = GraphDataset(os.path.join(processed, f))
+        counts.append(ds[0]["loc"].shape[0])
+    assert sum(counts) == N_PART
+    assert len(set(counts)) > 1, f"expected uneven metis partitions, got {counts}"
+
+    # log.json artifact written by the shared trainer
+    runs = os.listdir(str(tmp_path))
+    assert any(os.path.exists(os.path.join(str(tmp_path), r, "log", "log.json"))
+               for r in runs)

@@ -41,13 +41,11 @@ def test_two_process_world_matches_single_process():
     port = _free_port()
     env = dict(os.environ)
     env.pop("PYTHONWARNINGS", None)
-    # keep the repo importable but DROP the TPU plugin path: its PJRT plugin
-    # registers during jax.distributed.initialize and hangs CPU-only workers
-    # when the TPU tunnel is unreachable
-    keep = [p for p in env.get("PYTHONPATH", "").split(os.pathsep)
-            if p and "axon" not in p]
-    env["PYTHONPATH"] = os.pathsep.join(
-        [os.path.dirname(os.path.dirname(_WORKER))] + keep)
+    # minimal PYTHONPATH = repo root only: site-packages come from the
+    # interpreter itself, and any extra PJRT plugin dirs on the inherited
+    # path (e.g. an unreachable TPU tunnel plugin) would register during
+    # jax.distributed.initialize and hang the CPU-only workers
+    env["PYTHONPATH"] = os.path.dirname(os.path.dirname(_WORKER))
     procs = [
         subprocess.Popen([sys.executable, _WORKER, str(port), str(pid)],
                          stdout=subprocess.PIPE, stderr=subprocess.STDOUT,

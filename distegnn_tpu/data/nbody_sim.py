@@ -364,7 +364,7 @@ def simulate_trajectories_batched(
         return jnp.swapaxes(loc, 0, 1), jnp.swapaxes(vel, 0, 1)  # [num, T, N, 3]
 
     if dtype == "float64":
-        with jax.experimental.enable_x64():
+        with jax.enable_x64(True):
             loc, vel = run(jnp.asarray(X0, jnp.float64),
                            jnp.asarray(V0, jnp.float64),
                            jnp.asarray(edges, jnp.float64))

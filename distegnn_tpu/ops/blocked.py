@@ -1,0 +1,276 @@
+"""Blocked-CSR edge aggregation — MXU kernels for the scatter/gather hot loop.
+
+Round-2 profiling (BASELINE.md "Step-time breakdown") showed the LargeFluid
+train step is NOT compute-bound: XLA's scatter-add runs one [E=1.6M, 64]
+edge->node aggregation in 22-33 ms (~19 GB/s effective, vs ~800 GB/s HBM) and
+gathers at ~43 GB/s, so the step spends >80% of its time in what the reference
+does with CUDA scatter kernels (models/FastEGNN.py:322-337, torch_scatter).
+
+The TPU-native fix is a LAYOUT, not a faster scatter. Edge lists are already
+sorted by destination row (ops/graph.py pad_graphs); here we additionally pad
+them so that every 256-node *block* owns a fixed-size slice of the edge axis:
+
+    edge slice [b*epb, (b+1)*epb)  holds exactly the edges whose destination
+    row lies in node block [b*256, (b+1)*256), padded with masked slots.
+
+With that invariant, both hot ops become *block-local dense matmuls* against a
+one-hot incidence tile generated in VMEM — pure MXU work, no scatter at all:
+
+    aggregate:  out[block b] += onehot[tile, 256]^T @ data[tile, F]
+    gather:     out[tile]     = onehot[tile, 256]   @ h[block b]
+
+The one-hot tile never touches HBM (built from an iota compare inside the
+kernel), so HBM traffic is one streaming read of the edge array and one write
+of the node array — the bandwidth floor. FLOP cost is E*256*F ~ 52 GFLOP at
+LargeFluid scale: noise for the MXU. The two kernels are exact adjoints, so
+``jax.custom_vjp`` wires aggregate-backward = gather and gather-backward =
+aggregate, killing the backward-pass scatters too (the round-2 profile's
+biggest single line).
+
+The blocked layout is still a valid row-sorted padded edge list, so every
+existing code path (XLA fallback, other models, the distributed partitioner)
+consumes it unchanged; the kernels are an opt-in fast path keyed on
+``GraphBatch.edge_block``.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+DEFAULT_BLOCK = 256       # nodes per block = one-hot matmul N dimension
+DEFAULT_EDGE_TILE = 512   # edges per grid step = one-hot matmul K dimension
+
+
+# ---------------------------------------------------------------------------
+# Host-side layout builder
+# ---------------------------------------------------------------------------
+
+def blockify_edges(
+    edge_index: np.ndarray,      # [2, e] int, rows sorted ascending
+    edge_attr: Optional[np.ndarray],  # [e, D] or None
+    n_nodes_padded: int,         # N, multiple of `block`
+    epb: int,                    # edge slots per block (multiple of edge_tile)
+    block: int = DEFAULT_BLOCK,
+):
+    """Re-lay one graph's row-sorted edge list into per-block padded slices.
+
+    Returns (edge_index' [2, NB*epb], edge_attr' [NB*epb, D], edge_mask'
+    [NB*epb]). Padding slots carry row = col = (their block's last node) so the
+    global row ordering stays ascending — the layout remains a legal
+    ``edges_sorted`` edge list for the XLA fallback path.
+    """
+    nb = n_nodes_padded // block
+    row = edge_index[0]
+    # block boundaries in the sorted row array
+    bounds = np.searchsorted(row, np.arange(nb + 1) * block)
+    counts = np.diff(bounds)
+    if counts.max(initial=0) > epb:
+        raise ValueError(f"blockify_edges: epb={epb} < max block degree {counts.max()}")
+    if bounds[-1] != edge_index.shape[1]:
+        raise ValueError("blockify_edges: edge rows exceed n_nodes_padded")
+
+    E = nb * epb
+    new_index = np.empty((2, E), np.int32)
+    pad_rows = np.arange(1, nb + 1, dtype=np.int32) * block - 1
+    new_index[0] = np.repeat(pad_rows, epb)
+    new_index[1] = new_index[0]
+    new_mask = np.zeros((E,), np.float32)
+    D = edge_attr.shape[1] if edge_attr is not None else 0
+    new_attr = np.zeros((E, D), np.float32)
+    for b in range(nb):
+        lo, hi = bounds[b], bounds[b + 1]
+        n = hi - lo
+        dst = b * epb
+        new_index[:, dst:dst + n] = edge_index[:, lo:hi]
+        new_mask[dst:dst + n] = 1.0
+        if D and edge_attr is not None:
+            new_attr[dst:dst + n] = edge_attr[lo:hi]
+    return new_index, new_attr, new_mask
+
+
+def max_block_degree(rows_sorted: np.ndarray, n_nodes_padded: int,
+                     block: int = DEFAULT_BLOCK) -> int:
+    """Max number of edges landing in any single node block (sorted rows)."""
+    nb = n_nodes_padded // block
+    bounds = np.searchsorted(rows_sorted, np.arange(nb + 1) * block)
+    return int(np.diff(bounds).max(initial=0))
+
+
+def slot_ids(row: jnp.ndarray, edge_mask: jnp.ndarray, block: int, epb: int) -> jnp.ndarray:
+    """Block-local destination ids with a sentinel for padding.
+
+    row/edge_mask: [..., E] in blocked layout. Returns int32 [..., E] where a
+    real edge at position k (block k//epb) gets ``row - block_idx*block`` in
+    [0, block) and a masked slot gets ``block`` — which matches no one-hot
+    column, so masked slots vanish from every kernel without a multiply.
+    """
+    E = row.shape[-1]
+    blk = (jnp.arange(E, dtype=jnp.int32) // epb) * block
+    local = row.astype(jnp.int32) - blk
+    return jnp.where(edge_mask > 0, local, block)
+
+
+# ---------------------------------------------------------------------------
+# Pallas kernels (single graph; batched wrappers vmap them)
+# ---------------------------------------------------------------------------
+
+def _use_interpret() -> bool:
+    return jax.default_backend() != "tpu"
+
+
+def _precision_for(dtype):
+    # f32 operands: 'highest' makes the MXU one-hot contraction exact (the
+    # one-hot factor is 0/1, so only data truncation matters — 3-pass bf16
+    # recovers full f32). bf16 operands: default single-pass.
+    return (jax.lax.Precision.HIGHEST
+            if jnp.dtype(dtype) == jnp.float32 else jax.lax.Precision.DEFAULT)
+
+
+def _seg_sum_kernel(slot_ref, data_ref, out_ref, *, block, precision):
+    t = pl.program_id(1)
+
+    @pl.when(t == 0)
+    def _():
+        out_ref[:] = jnp.zeros_like(out_ref)
+
+    tile = slot_ref.shape[0]
+    onehot = (slot_ref[:] == jax.lax.broadcasted_iota(jnp.int32, (tile, block), 1))
+    out_ref[:] += jax.lax.dot_general(
+        onehot.astype(data_ref.dtype), data_ref[:],
+        (((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32, precision=precision,
+    )
+
+
+def _gather_kernel(slot_ref, h_ref, out_ref, *, block, precision):
+    tile = slot_ref.shape[0]
+    onehot = (slot_ref[:] == jax.lax.broadcasted_iota(jnp.int32, (tile, block), 1))
+    out_ref[:] = jax.lax.dot_general(
+        onehot.astype(h_ref.dtype), h_ref[:],
+        (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32, precision=precision,
+    ).astype(out_ref.dtype)
+
+
+def _layout(E: int, n_nodes: int, block: int, tile: int):
+    nb, rem = divmod(n_nodes, block)
+    if rem:
+        raise ValueError(f"n_nodes {n_nodes} not a multiple of block {block}")
+    epb, rem = divmod(E, nb)
+    if rem:
+        raise ValueError(f"E {E} not a multiple of num_blocks {nb}")
+    ept, rem = divmod(epb, tile)
+    if rem:
+        raise ValueError(f"edges/block {epb} not a multiple of tile {tile}")
+    return nb, ept
+
+
+@functools.partial(jax.jit, static_argnames=("n_nodes", "block", "tile"))
+def _seg_sum_impl(data, slot, n_nodes: int, block: int, tile: int):
+    """[E, F] + slots -> [N, F] float32 (blocked one-hot MXU aggregation)."""
+    E, F = data.shape
+    nb, ept = _layout(E, n_nodes, block, tile)
+    kern = functools.partial(_seg_sum_kernel, block=block,
+                             precision=_precision_for(data.dtype))
+    return pl.pallas_call(
+        kern,
+        grid=(nb, ept),
+        in_specs=[
+            pl.BlockSpec((tile, 1), lambda b, t: (b * ept + t, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((tile, F), lambda b, t: (b * ept + t, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((block, F), lambda b, t: (b, 0),
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((n_nodes, F), jnp.float32),
+        interpret=_use_interpret(),
+    )(slot[:, None], data)
+
+
+@functools.partial(jax.jit, static_argnames=("block", "tile"))
+def _gather_impl(h, slot, block: int, tile: int):
+    """[N, F] + slots [E] -> [E, F] (blocked one-hot MXU gather)."""
+    n_nodes, F = h.shape
+    E = slot.shape[0]
+    nb, ept = _layout(E, n_nodes, block, tile)
+    kern = functools.partial(_gather_kernel, block=block,
+                             precision=_precision_for(h.dtype))
+    return pl.pallas_call(
+        kern,
+        grid=(nb, ept),
+        in_specs=[
+            pl.BlockSpec((tile, 1), lambda b, t: (b * ept + t, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((block, F), lambda b, t: (b, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((tile, F), lambda b, t: (b * ept + t, 0),
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((E, F), h.dtype),
+        interpret=_use_interpret(),
+    )(slot[:, None], h)
+
+
+# ---------------------------------------------------------------------------
+# Differentiable single-graph ops (exact adjoint pair)
+# ---------------------------------------------------------------------------
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2, 3, 4))
+def _seg_sum(data, slot, n_nodes, block, tile):
+    return _seg_sum_impl(data, slot, n_nodes, block, tile)
+
+
+def _seg_sum_fwd(data, slot, n_nodes, block, tile):
+    out = _seg_sum_impl(data, slot, n_nodes, block, tile)
+    return out, (slot, jnp.zeros((), data.dtype))
+
+
+def _seg_sum_bwd(n_nodes, block, tile, res, g):
+    slot, proto = res
+    return _gather_impl(g.astype(proto.dtype), slot, block, tile), None
+
+
+_seg_sum.defvjp(_seg_sum_fwd, _seg_sum_bwd)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2, 3))
+def _gather(h, slot, block, tile):
+    return _gather_impl(h, slot, block, tile)
+
+
+def _gather_fwd(h, slot, block, tile):
+    return _gather_impl(h, slot, block, tile), (slot, jnp.zeros((0,) + h.shape[:1], h.dtype))
+
+
+def _gather_bwd(block, tile, res, g):
+    slot, proto = res
+    n_nodes = proto.shape[1]
+    return _seg_sum_impl(g, slot, n_nodes, block, tile).astype(proto.dtype), None
+
+
+_gather.defvjp(_gather_fwd, _gather_bwd)
+
+
+# ---------------------------------------------------------------------------
+# Public batched API (mirrors ops.segment signatures)
+# ---------------------------------------------------------------------------
+
+def blocked_segment_sum(data, slot, num_segments: int, block: int = DEFAULT_BLOCK,
+                        tile: int = DEFAULT_EDGE_TILE):
+    """Batched [B, E, F] -> [B, N, F] float32. ``slot`` from :func:`slot_ids`
+    (masked slots carry the sentinel and contribute nothing)."""
+    return jax.vmap(lambda d, s: _seg_sum(d, s, num_segments, block, tile))(data, slot)
+
+
+def blocked_gather(h, slot, block: int = DEFAULT_BLOCK, tile: int = DEFAULT_EDGE_TILE):
+    """Batched [B, N, F] -> [B, E, F]; rows fetched block-locally (masked
+    slots read as 0). Adjoint of :func:`blocked_segment_sum`."""
+    return jax.vmap(lambda hh, s: _gather(hh, s, block, tile))(h, slot)

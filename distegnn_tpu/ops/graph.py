@@ -42,6 +42,12 @@ class GraphBatch:
     ``edges_sorted`` (static) — True when every graph's edge rows are
     ascending, including the padded tail (padding points at node N-1, the
     last padded slot). Lets aggregations use XLA's sorted-scatter lowering.
+
+    ``edge_block`` (static) — 0, or the node-block size of a blocked edge
+    layout (see ops/blocked.py): N is a multiple of edge_block and edge slice
+    [b*epb, (b+1)*epb) holds exactly the edges whose row is in node block b.
+    Enables the MXU one-hot aggregation kernels; the layout is still a valid
+    row-sorted edge list, so every non-kernel path works unchanged.
     """
 
     node_feat: jnp.ndarray
@@ -55,6 +61,8 @@ class GraphBatch:
     edge_attr: jnp.ndarray
     edge_mask: jnp.ndarray
     edges_sorted: bool = struct.field(pytree_node=False, default=False)
+    edge_block: int = struct.field(pytree_node=False, default=0)
+    edge_tile: int = struct.field(pytree_node=False, default=0)
 
     @property
     def batch_size(self) -> int:
@@ -72,6 +80,12 @@ class GraphBatch:
     def n_node(self) -> jnp.ndarray:
         """[B] float — true node count per graph (per partition when sharded)."""
         return jnp.sum(self.node_mask, axis=1)
+
+    @property
+    def edges_per_block(self) -> int:
+        """Edge slots per node block (blocked layout only)."""
+        assert self.edge_block > 0, "not a blocked layout"
+        return self.max_edges // (self.max_nodes // self.edge_block)
 
     @property
     def row(self) -> jnp.ndarray:
@@ -93,12 +107,22 @@ def pad_graphs(
     node_bucket: int = 8,
     edge_bucket: int = 128,
     dtype=np.float32,
+    edge_block: int = 0,
+    edges_per_block: Optional[int] = None,
+    edge_tile: int = 512,
 ) -> "GraphBatch":
     """Pack a list of per-graph numpy dicts into one padded GraphBatch.
 
     Each dict has keys: node_feat [n,F], loc/vel/target [n,3], edge_index [2,e],
     edge_attr [e,D], optional node_attr [n,A], optional loc_mean [3].
     Bucketing rounds N/E up so nearby sizes share one compiled program.
+
+    ``edge_block > 0`` emits the blocked layout (ops/blocked.py): N rounds up
+    to a multiple of edge_block and each node block owns a fixed slice of
+    ``edges_per_block`` edge slots (auto: max block degree over the batch,
+    rounded to edge_tile; loaders pass a dataset-stable value to avoid
+    per-batch recompiles). Requires row-sorted edge input (all in-tree
+    builders emit it; unsorted input is stable-sorted here).
 
     loc_mean contract: when a dict omits loc_mean, it falls back to the mean of
     the dict's OWN positions — correct only for whole (unpartitioned) graphs.
@@ -108,11 +132,44 @@ def pad_graphs(
     """
     bsz = len(graphs)
     n_max = max(g["loc"].shape[0] for g in graphs)
-    e_max = max(g["edge_index"].shape[1] for g in graphs)
-    N = max_nodes if max_nodes is not None else _round_up(max(n_max, 1), node_bucket)
-    E = max_edges if max_edges is not None else _round_up(max(e_max, 1), edge_bucket)
-    if N < n_max or E < e_max:
-        raise ValueError(f"pad_graphs: max_nodes/max_edges ({N},{E}) < actual ({n_max},{e_max})")
+    if edge_block:
+        from distegnn_tpu.ops.blocked import blockify_edges, max_block_degree
+
+        if max_nodes is not None and max_nodes < n_max:
+            raise ValueError(f"pad_graphs: max_nodes {max_nodes} < actual {n_max}")
+        if max_edges is not None:
+            raise ValueError("pad_graphs: max_edges is unsupported with "
+                             "edge_block; pass edges_per_block instead")
+        if edges_per_block is not None and edges_per_block % edge_tile:
+            raise ValueError(f"pad_graphs: edges_per_block {edges_per_block} "
+                             f"not a multiple of edge_tile {edge_tile}")
+        N = _round_up(max(max_nodes or 0, n_max, 1), edge_block)
+        sorted_graphs = []
+        for g in graphs:
+            g = dict(g)
+            if np.any(np.diff(g["edge_index"][0]) < 0):
+                order = np.argsort(g["edge_index"][0], kind="stable")
+                g["edge_index"] = g["edge_index"][:, order]
+                if g.get("edge_attr") is not None:
+                    g["edge_attr"] = g["edge_attr"][order]
+            sorted_graphs.append(g)
+        graphs = sorted_graphs
+        if edges_per_block is None:
+            deg = max(max_block_degree(g["edge_index"][0], N, edge_block)
+                      for g in graphs)
+            edges_per_block = _round_up(max(deg, 1), edge_tile)
+        for g in graphs:
+            ei, ea, em = blockify_edges(
+                g["edge_index"].astype(np.int64), g.get("edge_attr"),
+                N, edges_per_block, edge_block)
+            g["edge_index"], g["edge_attr"], g["_edge_mask"] = ei, ea, em
+        E = (N // edge_block) * edges_per_block
+    else:
+        e_max = max(g["edge_index"].shape[1] for g in graphs)
+        E = max_edges if max_edges is not None else _round_up(max(e_max, 1), edge_bucket)
+        N = max_nodes if max_nodes is not None else _round_up(max(n_max, 1), node_bucket)
+        if N < n_max or E < e_max:
+            raise ValueError(f"pad_graphs: max_nodes/max_edges ({N},{E}) < actual ({n_max},{e_max})")
 
     F = graphs[0]["node_feat"].shape[1]
     A = graphs[0].get("node_attr", np.zeros((0, 0))).shape[1] if graphs[0].get("node_attr") is not None else 0
@@ -148,17 +205,21 @@ def pad_graphs(
         loc_mean[b] = g["loc_mean"] if g.get("loc_mean") is not None else g["loc"].mean(axis=0)
         node_mask[b, :n] = 1.0
         edge_index[b, :, :e] = g["edge_index"]
-        if e and (np.any(np.diff(g["edge_index"][0]) < 0)
-                  or g["edge_index"][0][-1] > N - 1):
-            edges_sorted = False
+        if (not edge_block) and e and (np.any(np.diff(g["edge_index"][0]) < 0)
+                                       or g["edge_index"][0][-1] > N - 1):
+            edges_sorted = False  # blocked layouts are ascending by construction
         if D and g.get("edge_attr") is not None:
             edge_attr[b, :e] = g["edge_attr"]
-        edge_mask[b, :e] = 1.0
+        if edge_block:
+            edge_mask[b, :e] = g["_edge_mask"]  # blocked layout: interior padding
+        else:
+            edge_mask[b, :e] = 1.0
 
     return GraphBatch(
         node_feat=node_feat, node_attr=node_attr, loc=loc, vel=vel, target=target,
         loc_mean=loc_mean, node_mask=node_mask, edge_index=edge_index,
         edge_attr=edge_attr, edge_mask=edge_mask, edges_sorted=edges_sorted,
+        edge_block=edge_block, edge_tile=edge_tile if edge_block else 0,
     )
 
 

@@ -87,6 +87,9 @@ _DEFAULTS: dict = {
         # padding buckets (TPU-only knobs; static-shape batching):
         "node_bucket": 8,
         "edge_bucket": 128,
+        # blocked edge layout for the MXU aggregation kernels (ops/blocked.py):
+        # 0 = off; 256 = recommended for large graphs (>= a few thousand nodes)
+        "edge_block": 0,
         # mesh data axis (TPU-only): graphs-per-step = batch_size *
         # data_parallel, sharded over DATA_AXIS; devices used =
         # world_size * data_parallel (distegnn_tpu/parallel/mesh.py)
@@ -102,6 +105,10 @@ _DEFAULTS: dict = {
         "accumulation_steps": 1,
         "warmup_epochs": 0,
         "scheduler": "None",
+        # TPU-only: 'auto'|True|False — run each epoch as ONE lax.scan program
+        # over a device-resident dataset (train/scan_epoch.py). 'auto' enables
+        # it for single-process cutoff_edges runs whose dataset fits in HBM.
+        "scan_epochs": "auto",
     },
     "log": {
         "log_dir": "./logs",

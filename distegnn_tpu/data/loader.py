@@ -58,22 +58,80 @@ class GraphLoader:
         edge_bucket: int = 128,
         max_nodes: int = None,
         max_edges: int = None,
+        edge_block: int = 0,
+        edges_per_block: int = None,
+        edge_tile: int = 512,
+        pairing: bool = None,
     ):
         self.dataset = dataset
         self.batch_size = batch_size
         self.shuffle = shuffle
         self.seed = seed
         self.epoch = 0
-        if max_nodes is None or max_edges is None:
-            n, e = dataset.size_maxima()
-            max_nodes = max_nodes if max_nodes is not None else _round_up(n, node_bucket)
-            max_edges = max_edges if max_edges is not None else _round_up(e, edge_bucket)
-        self.max_nodes, self.max_edges = max_nodes, max_edges
+        self.edge_block, self.edge_tile = edge_block, edge_tile
+        self.pairing = False
+        self._prepared_cache = None
+        if edge_block:
+            # dataset-stable blocked layout: ONE edges_per_block and ONE
+            # pairing decision for every batch (single scan up front), so the
+            # whole run keeps a single pytree structure / compiled program
+            from distegnn_tpu.ops.blocked import scan_dataset_for_blocking
+
+            if max_edges is not None:
+                raise ValueError("GraphLoader: max_edges is unsupported with "
+                                 "edge_block; pass edges_per_block instead")
+            n, _ = dataset.size_maxima()
+            self.max_nodes = _round_up(max(max_nodes or 0, n, 1), edge_block)
+            if edges_per_block is None or pairing is None:
+                deg, sym = scan_dataset_for_blocking(
+                    dataset, self.max_nodes, edge_block)
+                if edges_per_block is None:
+                    edges_per_block = _round_up(deg, edge_tile)
+                pairing = sym if pairing is None else pairing
+            self.pairing = pairing
+            self.edges_per_block = edges_per_block
+            self.max_edges = (self.max_nodes // edge_block) * edges_per_block
+            # cache prepared (blockified) graphs across epochs when affordable:
+            # per-graph blocked edge payload ~ E * (2 idx + attrs + mask + pair)
+            d0 = dataset[0].get("edge_attr")
+            per = self.max_edges * (8 + 4 + 8 + (d0.shape[1] * 4 if d0 is not None else 0))
+            if per * len(dataset) <= 2 << 30:
+                self._prepared_cache = {}
+        else:
+            self.edges_per_block = None
+            if max_nodes is None or max_edges is None:
+                n, e = dataset.size_maxima()
+                max_nodes = max_nodes if max_nodes is not None else _round_up(n, node_bucket)
+                max_edges = max_edges if max_edges is not None else _round_up(e, edge_bucket)
+            self.max_nodes, self.max_edges = max_nodes, max_edges
         if len(self) == 0:
             raise ValueError(
                 f"batch_size {batch_size} > dataset size {len(dataset)}: "
                 "drop_last leaves zero batches"
             )
+
+    def pad_kwargs(self) -> dict:
+        """kwargs that make pad_graphs emit this loader's (stable) layout."""
+        if self.edge_block:
+            return dict(edge_block=self.edge_block, edge_tile=self.edge_tile,
+                        edges_per_block=self.edges_per_block,
+                        max_nodes=self.max_nodes, compute_pair=self.pairing)
+        return dict(max_nodes=self.max_nodes, max_edges=self.max_edges)
+
+    def _graph(self, i: int) -> dict:
+        """Fetch graph i, blockified (and cached) when edge_block is on."""
+        if not self.edge_block:
+            return self.dataset[i]
+        if self._prepared_cache is not None and i in self._prepared_cache:
+            return self._prepared_cache[i]
+        from distegnn_tpu.ops.blocked import prepare_blocked_graph
+
+        g = prepare_blocked_graph(self.dataset[i], self.max_nodes,
+                                  self.edges_per_block, self.edge_block,
+                                  compute_pair=self.pairing)
+        if self._prepared_cache is not None:
+            self._prepared_cache[i] = g
+        return g
 
     def set_epoch(self, epoch: int) -> None:
         self.epoch = epoch
@@ -91,8 +149,7 @@ class GraphLoader:
         for b in range(len(self)):
             idx = order[b * self.batch_size:(b + 1) * self.batch_size]
             yield pad_graphs(
-                [self.dataset[int(i)] for i in idx],
-                max_nodes=self.max_nodes, max_edges=self.max_edges,
+                [self._graph(int(i)) for i in idx], **self.pad_kwargs(),
             )
 
 
@@ -118,6 +175,8 @@ class ShardedGraphLoader:
         node_bucket: int = 8,
         edge_bucket: int = 128,
         data_parallel: int = 1,
+        edge_block: int = 0,
+        edge_tile: int = 512,
     ):
         sizes = {len(d) for d in datasets}
         if len(sizes) != 1:
@@ -126,13 +185,32 @@ class ShardedGraphLoader:
         n = max(m[0] for m in maxima)
         e = max(m[1] for m in maxima)
         self.data_parallel = data_parallel
-        self.loaders = [
-            GraphLoader(
-                d, batch_size * data_parallel, shuffle=shuffle, seed=seed,
-                max_nodes=_round_up(n, node_bucket), max_edges=_round_up(e, edge_bucket),
-            )
-            for d in datasets
-        ]
+        if edge_block:
+            # one blocked layout across ALL shards so the [P, B, ...] stack is
+            # rectangular: common N, common edges_per_block, and ONE pairing
+            # decision (max/AND over shards)
+            from distegnn_tpu.ops.blocked import scan_dataset_for_blocking
+
+            N = _round_up(n, edge_block)
+            scans = [scan_dataset_for_blocking(d, N, edge_block) for d in datasets]
+            epb = _round_up(max(s[0] for s in scans), edge_tile)
+            pairing = all(s[1] for s in scans)
+            self.loaders = [
+                GraphLoader(
+                    d, batch_size * data_parallel, shuffle=shuffle, seed=seed,
+                    max_nodes=N, edge_block=edge_block, edge_tile=edge_tile,
+                    edges_per_block=epb, pairing=pairing,
+                )
+                for d in datasets
+            ]
+        else:
+            self.loaders = [
+                GraphLoader(
+                    d, batch_size * data_parallel, shuffle=shuffle, seed=seed,
+                    max_nodes=_round_up(n, node_bucket), max_edges=_round_up(e, edge_bucket),
+                )
+                for d in datasets
+            ]
 
     @property
     def num_partitions(self) -> int:
@@ -148,6 +226,9 @@ class ShardedGraphLoader:
     def __iter__(self):
         D = self.data_parallel
         for parts in zip(*self.loaders):
+            if any(p.edge_pair is None for p in parts):
+                # pairing must be all-or-nothing for a rectangular stack
+                parts = [p.replace(edge_pair=None) for p in parts]
             stacked = jax.tree.map(lambda *xs: np.stack(xs, axis=0), *parts)
             if D > 1:
                 # [P, D*B, ...] -> [D, P, B, ...]

@@ -24,7 +24,8 @@ import jax.numpy as jnp
 from flax import linen as nn
 
 from distegnn_tpu.models.common import MLP, CoordMLP, TorchDense, gather_nodes, resolve_dtype
-from distegnn_tpu.ops.blocked import blocked_gather, blocked_segment_sum, slot_ids
+from distegnn_tpu.ops.blocked import (blocked_gather, blocked_segment_sum,
+                                      paired_col_gather, slot_ids)
 from distegnn_tpu.ops.graph import GraphBatch
 from distegnn_tpu.ops.segment import segment_sum, segment_mean
 from distegnn_tpu.parallel.collectives import global_node_mean
@@ -85,6 +86,14 @@ class EGCLVel(nn.Module):
                 return blocked_gather(data, slot, g.edge_block, g.edge_tile)
             return gather_nodes(data, row)
 
+        def gather_cols(data):
+            """data[b, col[b, e]]; on symmetric blocked graphs the backward
+            aggregation rides the reverse-edge permutation + MXU kernel."""
+            if blocked and g.edge_pair is not None:
+                return paired_col_gather(data, col, g.edge_pair, slot,
+                                         g.edge_block, g.edge_tile)
+            return gather_nodes(data, col)
+
         def agg_rows_mean(data):
             """Per-destination mean over real edges (count clamped >= 1)."""
             if blocked:
@@ -94,7 +103,7 @@ class EGCLVel(nn.Module):
                 t, r, N, mask=m, indices_are_sorted=srt))(data, row, edge_mask)
 
         # --- real-edge geometry (reference coord2radial, :237-246)
-        coord_diff = gather_rows(x) - gather_nodes(x, col)              # [B, E, 3]
+        coord_diff = gather_rows(x) - gather_cols(x)                    # [B, E, 3]
         radial = jnp.sum(coord_diff**2, axis=-1, keepdims=True)         # [B, E, 1]
         if self.normalize:
             norm = jax.lax.stop_gradient(jnp.sqrt(radial)) + self.epsilon
@@ -105,7 +114,7 @@ class EGCLVel(nn.Module):
         virtual_radial = jnp.linalg.norm(vcd, axis=2, keepdims=True)    # [B, N, 1, C]
 
         # --- real edge messages phi_e (:144-150)
-        e_in = [gather_rows(h), gather_nodes(h, col), radial]
+        e_in = [gather_rows(h), gather_cols(h), radial]
         if self.edge_attr_nf:
             e_in.append(g.edge_attr)
         edge_feat = MLP([H, H], act_last=True, name="phi_e", dtype=dt)(jnp.concatenate(e_in, axis=-1))

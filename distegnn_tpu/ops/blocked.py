@@ -103,6 +103,62 @@ def max_block_degree(rows_sorted: np.ndarray, n_nodes_padded: int,
     return int(np.diff(bounds).max(initial=0))
 
 
+def pairing_perm(edge_index: np.ndarray) -> Optional[np.ndarray]:
+    """Reverse-edge involution P: edge_index[:, P[e]] == (col[e], row[e]).
+
+    Radius graphs are symmetric (every (i,j) has its (j,i)), so the transpose
+    of the sparse incidence is just a permutation of the edge axis. That lets
+    the backward col-scatter — the one aggregation the blocked kernels can't
+    reach directly — become gather-by-P + blocked row aggregation (see
+    paired_col_gather). Returns None when the edge list isn't symmetric
+    (caller falls back to XLA scatter). Works on blocked layouts too: padding
+    slots carry row == col and pair among themselves.
+    """
+    r, c = edge_index[0], edge_index[1]
+    by_rc = np.lexsort((c, r))
+    by_cr = np.lexsort((r, c))
+    pair = np.empty(r.shape[0], np.int64)
+    pair[by_rc] = by_cr
+    if not (np.array_equal(r[pair], c) and np.array_equal(c[pair], r)):
+        return None
+    return pair
+
+
+def prepare_blocked_graph(g: dict, n_nodes_padded: int, epb: int, block: int,
+                          compute_pair: bool = True) -> dict:
+    """Blockify one graph dict in place-of (returns a copy): row-sort if
+    needed, re-lay edges per block, and attach the reverse-edge pairing.
+    Idempotent: a dict already carrying the matching ``_blockified`` stamp is
+    returned unchanged (loaders cache prepared graphs across epochs)."""
+    stamp = (n_nodes_padded, epb, block)
+    if g.get("_blockified") == stamp:
+        return g
+    g = dict(g)
+    if np.any(np.diff(g["edge_index"][0]) < 0):
+        order = np.argsort(g["edge_index"][0], kind="stable")
+        g["edge_index"] = g["edge_index"][:, order]
+        if g.get("edge_attr") is not None:
+            g["edge_attr"] = g["edge_attr"][order]
+    ei, ea, em = blockify_edges(g["edge_index"].astype(np.int64),
+                                g.get("edge_attr"), n_nodes_padded, epb, block)
+    g["edge_index"], g["edge_attr"], g["_edge_mask"] = ei, ea, em
+    g["_edge_pair"] = pairing_perm(ei) if compute_pair else None
+    g["_blockified"] = stamp
+    return g
+
+
+def scan_dataset_for_blocking(dataset, n_nodes_padded: int, block: int):
+    """One pass over a dataset: (max block degree, every-graph-symmetric).
+    Both are layout decisions that must be made ONCE per dataset so every
+    batch of a run shares a single pytree structure / compiled program."""
+    deg, symmetric = 1, True
+    for i in range(len(dataset)):
+        ei = dataset[i]["edge_index"]
+        deg = max(deg, max_block_degree(np.sort(ei[0]), n_nodes_padded, block))
+        symmetric = symmetric and pairing_perm(ei) is not None
+    return deg, symmetric
+
+
 def slot_ids(row: jnp.ndarray, edge_mask: jnp.ndarray, block: int, epb: int) -> jnp.ndarray:
     """Block-local destination ids with a sentinel for padding.
 
@@ -259,6 +315,26 @@ def _gather_bwd(block, tile, res, g):
 _gather.defvjp(_gather_fwd, _gather_bwd)
 
 
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5))
+def _paired_gather(h, col, pair, slot, block, tile):
+    return jnp.take(h, col, axis=0)
+
+
+def _paired_gather_fwd(h, col, pair, slot, block, tile):
+    out = jnp.take(h, col, axis=0)
+    return out, (pair, slot, jnp.zeros((0,) + h.shape[:1], h.dtype))
+
+
+def _paired_gather_bwd(block, tile, res, g):
+    pair, slot, proto = res
+    n_nodes = proto.shape[1]
+    grad_h = _seg_sum_impl(jnp.take(g, pair, axis=0), slot, n_nodes, block, tile)
+    return grad_h.astype(proto.dtype), None, None, None
+
+
+_paired_gather.defvjp(_paired_gather_fwd, _paired_gather_bwd)
+
+
 # ---------------------------------------------------------------------------
 # Public batched API (mirrors ops.segment signatures)
 # ---------------------------------------------------------------------------
@@ -274,3 +350,13 @@ def blocked_gather(h, slot, block: int = DEFAULT_BLOCK, tile: int = DEFAULT_EDGE
     """Batched [B, N, F] -> [B, E, F]; rows fetched block-locally (masked
     slots read as 0). Adjoint of :func:`blocked_segment_sum`."""
     return jax.vmap(lambda hh, s: _gather(hh, s, block, tile))(h, slot)
+
+
+def paired_col_gather(h, col, pair, slot, block: int = DEFAULT_BLOCK,
+                      tile: int = DEFAULT_EDGE_TILE):
+    """Batched h[b, col[b, e]] whose BACKWARD is perm-gather + blocked row
+    aggregation instead of an unsorted XLA scatter: the transpose of a
+    symmetric graph's incidence is the edge permutation ``pair``
+    (:func:`pairing_perm`), so grad_h = seg_sum(grad[pair], slot)."""
+    return jax.vmap(lambda hh, c, p, s: _paired_gather(hh, c, p, s, block, tile))(
+        h, col, pair, slot)

@@ -60,6 +60,9 @@ class GraphBatch:
     edge_index: jnp.ndarray
     edge_attr: jnp.ndarray
     edge_mask: jnp.ndarray
+    # [B, E] reverse-edge involution (symmetric graphs, blocked layout only):
+    # lets backward col-aggregations ride the MXU kernels (ops/blocked.py)
+    edge_pair: Optional[jnp.ndarray] = None
     edges_sorted: bool = struct.field(pytree_node=False, default=False)
     edge_block: int = struct.field(pytree_node=False, default=0)
     edge_tile: int = struct.field(pytree_node=False, default=0)
@@ -110,6 +113,7 @@ def pad_graphs(
     edge_block: int = 0,
     edges_per_block: Optional[int] = None,
     edge_tile: int = 512,
+    compute_pair: bool = True,
 ) -> "GraphBatch":
     """Pack a list of per-graph numpy dicts into one padded GraphBatch.
 
@@ -133,7 +137,8 @@ def pad_graphs(
     bsz = len(graphs)
     n_max = max(g["loc"].shape[0] for g in graphs)
     if edge_block:
-        from distegnn_tpu.ops.blocked import blockify_edges, max_block_degree
+        from distegnn_tpu.ops.blocked import (max_block_degree,
+                                              prepare_blocked_graph)
 
         if max_nodes is not None and max_nodes < n_max:
             raise ValueError(f"pad_graphs: max_nodes {max_nodes} < actual {n_max}")
@@ -144,25 +149,19 @@ def pad_graphs(
             raise ValueError(f"pad_graphs: edges_per_block {edges_per_block} "
                              f"not a multiple of edge_tile {edge_tile}")
         N = _round_up(max(max_nodes or 0, n_max, 1), edge_block)
-        sorted_graphs = []
-        for g in graphs:
-            g = dict(g)
-            if np.any(np.diff(g["edge_index"][0]) < 0):
-                order = np.argsort(g["edge_index"][0], kind="stable")
-                g["edge_index"] = g["edge_index"][:, order]
-                if g.get("edge_attr") is not None:
-                    g["edge_attr"] = g["edge_attr"][order]
-            sorted_graphs.append(g)
-        graphs = sorted_graphs
         if edges_per_block is None:
-            deg = max(max_block_degree(g["edge_index"][0], N, edge_block)
+            deg = max(max_block_degree(np.sort(g["edge_index"][0]), N, edge_block)
                       for g in graphs)
             edges_per_block = _round_up(max(deg, 1), edge_tile)
-        for g in graphs:
-            ei, ea, em = blockify_edges(
-                g["edge_index"].astype(np.int64), g.get("edge_attr"),
-                N, edges_per_block, edge_block)
-            g["edge_index"], g["edge_attr"], g["_edge_mask"] = ei, ea, em
+        graphs = [prepare_blocked_graph(g, N, edges_per_block, edge_block,
+                                        compute_pair=compute_pair)
+                  for g in graphs]
+        pairs = [g["_edge_pair"] for g in graphs]
+        # all-or-nothing across the batch: one pytree structure per layout.
+        # Loaders make this dataset-stable by scanning up front and passing
+        # compute_pair accordingly (scan_dataset_for_blocking).
+        edge_pair = (np.stack(pairs).astype(np.int32)
+                     if all(p is not None for p in pairs) else None)
         E = (N // edge_block) * edges_per_block
     else:
         e_max = max(g["edge_index"].shape[1] for g in graphs)
@@ -170,6 +169,7 @@ def pad_graphs(
         N = max_nodes if max_nodes is not None else _round_up(max(n_max, 1), node_bucket)
         if N < n_max or E < e_max:
             raise ValueError(f"pad_graphs: max_nodes/max_edges ({N},{E}) < actual ({n_max},{e_max})")
+        edge_pair = None
 
     F = graphs[0]["node_feat"].shape[1]
     A = graphs[0].get("node_attr", np.zeros((0, 0))).shape[1] if graphs[0].get("node_attr") is not None else 0
@@ -220,6 +220,7 @@ def pad_graphs(
         loc_mean=loc_mean, node_mask=node_mask, edge_index=edge_index,
         edge_attr=edge_attr, edge_mask=edge_mask, edges_sorted=edges_sorted,
         edge_block=edge_block, edge_tile=edge_tile if edge_block else 0,
+        edge_pair=edge_pair,
     )
 
 

@@ -222,7 +222,7 @@ def run_distributed(config):
         loaders.append(_PuttingLoader(ShardedGraphLoader(
             datasets, d.batch_size, shuffle=(split_idx == 0), seed=config.seed,
             node_bucket=d.node_bucket, edge_bucket=d.edge_bucket,
-            data_parallel=dp,
+            data_parallel=dp, edge_block=d.edge_block,
         ), put))
     loader_train, loader_valid, loader_test = loaders
     print(f"Data ready: {len(loader_train.loader.loaders[0].dataset)} graphs x "

@@ -64,8 +64,13 @@ def train(
     config,
     start_epoch: int = 0,
     log: bool = True,
+    scan_runner=None,
 ):
-    """Full training run. Returns (state, best_log_dict, log_dict)."""
+    """Full training run. Returns (state, best_log_dict, log_dict).
+
+    ``scan_runner`` (train/scan_epoch.ScanEpochRunner) replaces the host-side
+    epoch loops with one lax.scan dispatch per epoch — same permutation, same
+    PRNG keys, same result; only the dispatch granularity changes."""
     train_cfg, log_cfg = config.train, config.log
     seed = config.seed
     is_main = jax.process_index() == 0
@@ -89,12 +94,20 @@ def train(
     start = time.perf_counter()
 
     for epoch in range(1 + start_epoch, train_cfg.epochs + 1):
-        state, loss_train = run_epoch_train(train_step, state, loader_train, seed, epoch)
+        if scan_runner is not None:
+            state, loss_train = scan_runner.train_epoch(state, epoch)
+            loss_train = float(loss_train)
+        else:
+            state, loss_train = run_epoch_train(train_step, state, loader_train, seed, epoch)
         log_dict["loss_train"].append(loss_train)
 
         if epoch % log_cfg.test_interval == 0:
-            loss_valid = run_epoch_eval(eval_step, state.params, loader_valid)
-            loss_test = run_epoch_eval(eval_step, state.params, loader_test)
+            if scan_runner is not None:
+                loss_valid = scan_runner.eval_epoch(state.params, "valid")
+                loss_test = scan_runner.eval_epoch(state.params, "test")
+            else:
+                loss_valid = run_epoch_eval(eval_step, state.params, loader_valid)
+                loss_test = run_epoch_eval(eval_step, state.params, loader_test)
             log_dict["epochs"].append(epoch)
             log_dict["loss"].append(loss_test)
 

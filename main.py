@@ -123,6 +123,7 @@ def main(argv=None):
     mk = lambda ds, shuffle: GraphLoader(
         ds, config.data.batch_size, shuffle=shuffle, seed=config.seed,
         node_bucket=config.data.node_bucket, edge_bucket=config.data.edge_bucket,
+        edge_block=config.data.edge_block,
     )
     loader_train, loader_valid, loader_test = mk(ds_train, True), mk(ds_valid, False), mk(ds_test, False)
 
@@ -157,9 +158,28 @@ def main(argv=None):
                                          mmd_samples=config.train.mmd.samples))
     eval_step = jax.jit(make_eval_step(model))
 
+    # scan_epochs: fold the epoch loop into one on-device lax.scan program
+    # (train/scan_epoch.py) when the dataset fits in HBM — kills the
+    # per-minibatch dispatch latency that dominates small-graph training
+    scan_runner = None
+    flag = config.train.scan_epochs
+    if flag is True or flag == "auto":
+        from distegnn_tpu.train.scan_epoch import ScanEpochRunner, dataset_nbytes
+
+        # budget: ~40% of device memory (params/opt/activations need the rest);
+        # memory_stats is unavailable on some backends -> assume 16 GB HBM
+        stats = jax.devices()[0].memory_stats() or {}
+        budget = int(stats.get("bytes_limit", 16 << 30) * 0.4)
+        total = sum(dataset_nbytes(l) for l in (loader_train, loader_valid, loader_test))
+        if total <= budget or flag is True:
+            scan_runner = ScanEpochRunner(
+                train_step, eval_step, loader_train, config.seed,
+                loader_valid=loader_valid, loader_test=loader_test)
+            print(f"scan_epochs: on ({total / 2**30:.2f} GiB device-resident)")
+
     state, best_state, best, log_dict = train(
         state, train_step, eval_step, loader_train, loader_valid, loader_test,
-        config, start_epoch=start_epoch,
+        config, start_epoch=start_epoch, scan_runner=scan_runner,
     )
     print(f"Done. Best: {best}")
     return best

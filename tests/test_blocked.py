@@ -142,3 +142,43 @@ def test_fastegnn_blocked_parity(compute_dtype):
     flat_b = ravel_pytree(gb)[0]
     scale = jnp.maximum(jnp.abs(flat_p).max(), 1.0)
     np.testing.assert_allclose(flat_b / scale, flat_p / scale, atol=5 * tol)
+
+
+def test_graph_loader_blocked_layout():
+    """GraphLoader(edge_block=...) emits a dataset-stable blocked layout."""
+    from distegnn_tpu.data.loader import GraphDataset, GraphLoader
+
+    rng = np.random.default_rng(6)
+    ds = GraphDataset(_nbody_like_graphs(rng, n_graphs=6, n=200))
+    ld = GraphLoader(ds, batch_size=2, shuffle=True, seed=3, edge_block=BLOCK)
+    batches = list(ld)
+    assert len(batches) == 3
+    for b in batches:
+        assert b.edge_block == BLOCK
+        assert b.max_nodes == ld.max_nodes and b.max_edges == ld.max_edges
+        # block invariant on every batch
+        epb = b.edges_per_block
+        blk = np.arange(b.max_edges) // epb
+        rows = np.asarray(b.row)
+        assert np.all(rows // BLOCK == blk[None, :])
+
+
+def test_pairing_perm():
+    from distegnn_tpu.ops.blocked import pairing_perm
+
+    rng = np.random.default_rng(8)
+    g = _nbody_like_graphs(rng, n_graphs=1, n=120)[0]
+    batch = pad_graphs([g], edge_block=BLOCK)
+    assert batch.edge_pair is not None
+    ei = np.asarray(batch.edge_index[0])
+    pair = np.asarray(batch.edge_pair[0])
+    assert np.array_equal(ei[0][pair], ei[1])
+    assert np.array_equal(ei[1][pair], ei[0])
+
+    # directed (asymmetric) list -> no pairing, model falls back
+    ei_dir = g["edge_index"][:, g["edge_index"][0] < g["edge_index"][1]]
+    assert pairing_perm(ei_dir) is None
+    g2 = dict(g, edge_index=ei_dir,
+              edge_attr=np.ones((ei_dir.shape[1], 2), np.float32))
+    b2 = pad_graphs([g2], edge_block=BLOCK)
+    assert b2.edge_pair is None

@@ -32,7 +32,8 @@ def fluid_dataset(tmp_path_factory):
 
 
 @pytest.mark.slow
-def test_largefluid_yaml_runs_distributed_metis(fluid_dataset, tmp_path):
+@pytest.mark.parametrize("edge_block", [0, 256])
+def test_largefluid_yaml_runs_distributed_metis(fluid_dataset, tmp_path, edge_block):
     from distegnn_tpu.config import load_config
     from distegnn_tpu.data import GraphDataset
     from distegnn_tpu.parallel.launch import run_distributed
@@ -45,6 +46,7 @@ def test_largefluid_yaml_runs_distributed_metis(fluid_dataset, tmp_path):
     config.data.outer_radius = RADIUS   # scaled for N_PART density
     config.data.inner_radius = RADIUS
     config.data.delta_t = 3
+    config.data.edge_block = edge_block  # 256: MXU kernel path under shard_map
     config.train.epochs = 2
     config.log.log_dir = str(tmp_path)
     assert config.data.split_mode == "metis"           # the yaml's real value

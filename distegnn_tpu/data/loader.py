@@ -62,6 +62,7 @@ class GraphLoader:
         edges_per_block: int = None,
         edge_tile: int = 512,
         pairing: bool = None,
+        cache_bytes: int = 2 << 30,
     ):
         self.dataset = dataset
         self.batch_size = batch_size
@@ -95,8 +96,13 @@ class GraphLoader:
             # per-graph blocked edge payload ~ E * (2 idx + attrs + mask + pair)
             d0 = dataset[0].get("edge_attr")
             per = self.max_edges * (8 + 4 + 8 + (d0.shape[1] * 4 if d0 is not None else 0))
-            if per * len(dataset) <= 2 << 30:
+            if per * len(dataset) <= cache_bytes:
                 self._prepared_cache = {}
+            else:
+                print(f"GraphLoader: blockify cache OFF "
+                      f"({per * len(dataset) / 2**30:.1f} GiB > "
+                      f"{cache_bytes / 2**30:.1f} GiB budget) — every epoch re-lays "
+                      f"edges on host; raise cache_bytes if RAM allows")
         else:
             self.edges_per_block = None
             if max_nodes is None or max_edges is None:

@@ -6,3 +6,11 @@ from distegnn_tpu.ops.segment import (  # noqa: F401
 )
 from distegnn_tpu.ops.graph import GraphBatch, pad_graphs, batch_graphs  # noqa: F401
 from distegnn_tpu.ops.radius import radius_graph_np, full_graph_np, cutoff_edges_np  # noqa: F401
+from distegnn_tpu.ops.blocked import (  # noqa: F401
+    blocked_gather,
+    blocked_segment_sum,
+    paired_col_gather,
+    pairing_perm,
+    slot_ids,
+)
+from distegnn_tpu.ops.radius_dev import radius_graph_dev, ell_to_edge_list  # noqa: F401

@@ -1,0 +1,138 @@
+"""On-device radius graph — jit-compatible neighbor search (static shapes).
+
+The host cell-list (ops/radius.py) is the right tool at preprocessing time,
+but rollouts and on-device data generation need edges rebuilt from PREDICTED
+positions every step without a host round-trip (the gap VERDICT r1 item 10 /
+SURVEY §2.9 left open; the reference rebuilds with torch_cluster on GPU,
+datasets/process_dataset.py:101,264). This is the XLA version:
+
+  1. spatial hash: cell = floor((pos - min)/r), bucket = hash(cell) mod H
+     (H static, ~2N buckets);
+  2. one argsort groups nodes by bucket; searchsorted gives bucket ranges;
+  3. each node probes its 27 neighboring cells, reading at most
+     ``max_per_cell`` candidates per bucket (static bound) — hash-collision
+     candidates are rejected by an exact integer cell-coordinate compare, so
+     no duplicate or phantom edges;
+  4. candidates are distance-filtered and sorted (valid first, nearest
+     first); the first ``max_degree`` survive.
+
+Everything is fixed-shape: [N, 27*max_per_cell] candidates, [N, max_degree]
+neighbors, so the whole search lives inside one jit/scan with no recompiles.
+
+Output doubles as a BLOCKED edge layout (ops/blocked.py): row-major
+[2, N*max_degree] with per-node uniform slots means every node block owns a
+fixed edge slice — exactly the invariant the MXU aggregation kernels need
+(edges_per_block = max_degree * edge_block; keep max_degree even so it is a
+multiple-of-512 slice at block 256). A rollout can therefore re-build the
+graph AND run the model without ever leaving the device.
+
+Capacity bounds (max_per_cell, max_degree) are static by design; overflow
+DROPS the farthest neighbors silently, so callers size them from data and
+check the returned ``overflow`` flags (host-side assert between rollouts, or
+a one-time calibration pass — see tests/test_radius_dev.py).
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+
+_P1, _P2, _P3 = 73856093, 19349663, 83492791  # classic spatial-hash primes
+
+
+class DeviceRadiusGraph(NamedTuple):
+    neighbors: jnp.ndarray   # [N, K] int32 — col index per slot (self when padded)
+    nbr_mask: jnp.ndarray    # [N, K] float32 0/1
+    # [N] int32 FOUND-neighbor count: exact iff cell_overflow is False (a
+    # full cell truncates the candidate list before counting). Calibration:
+    # raise max_per_cell until cell_overflow clears, THEN size max_degree
+    # from max(degree).
+    degree: jnp.ndarray
+    cell_overflow: jnp.ndarray    # [] bool — some real cell exceeded max_per_cell
+    degree_overflow: jnp.ndarray  # [] bool — some node exceeded max_degree
+
+
+def radius_graph_dev(
+    pos: jnp.ndarray,            # [N, 3]
+    r: float,
+    max_degree: int,
+    max_per_cell: int = 8,
+    node_mask: Optional[jnp.ndarray] = None,  # [N] 0/1; masked nodes isolated
+    num_buckets: Optional[int] = None,
+) -> DeviceRadiusGraph:
+    """All neighbors within ``r`` (strict, like radius_graph_np), ELL layout."""
+    N = pos.shape[0]
+    H = num_buckets or max(1 << (2 * N - 1).bit_length(), 16)
+    valid = (jnp.ones((N,), jnp.float32) if node_mask is None
+             else node_mask.astype(jnp.float32))
+    big = jnp.float32(1e30)
+
+    # cells relative to the masked min corner
+    anchor = jnp.min(jnp.where(valid[:, None] > 0, pos, big), axis=0)
+    cell = jnp.floor((pos - anchor) / r).astype(jnp.int32)          # [N, 3]
+    # each masked node gets its own unreachable cell: they never appear as
+    # candidates AND never pile into one bucket (which would trip
+    # cell_overflow spuriously on padded inputs)
+    me = jnp.arange(N, dtype=jnp.int32)
+    far = jnp.stack([-(1 << 20) - me, jnp.zeros_like(me), jnp.zeros_like(me)], -1)
+    cell = jnp.where(valid[:, None] > 0, cell, far)
+
+    def bucket_of(c):
+        h = (c[..., 0] * _P1) ^ (c[..., 1] * _P2) ^ (c[..., 2] * _P3)
+        return jnp.abs(h) % H
+
+    bucket = bucket_of(cell)                                        # [N]
+    order = jnp.argsort(bucket)                                     # [N]
+    sorted_bucket = bucket[order]
+
+    # 27 neighboring cells per node
+    off = jnp.stack(jnp.meshgrid(*([jnp.arange(-1, 2)] * 3),
+                                 indexing="ij"), axis=-1).reshape(27, 3)
+    probe_cell = cell[:, None, :] + off[None, :, :]                 # [N, 27, 3]
+    probe_bucket = bucket_of(probe_cell)                            # [N, 27]
+
+    start = jnp.searchsorted(sorted_bucket, probe_bucket)           # [N, 27]
+    end = jnp.searchsorted(sorted_bucket, probe_bucket, side="right")
+    M = max_per_cell
+    slots = start[..., None] + jnp.arange(M)[None, None, :]         # [N, 27, M]
+    in_range = slots < end[..., None]
+    cand = jnp.take(order, jnp.clip(slots, 0, N - 1), axis=0)       # [N, 27, M]
+
+    # exact cell compare: kills hash-collision candidates (and duplicates)
+    same_cell = jnp.all(cell[cand] == probe_cell[:, :, None, :], axis=-1)
+    cand_ok = in_range & same_cell
+    # only probes of REAL nodes count toward overflow
+    cell_overflow = jnp.any(((end - start) > M) & (valid[:, None] > 0))
+
+    cand = cand.reshape(N, 27 * M)
+    cand_ok = cand_ok.reshape(N, 27 * M)
+    d2 = jnp.sum((pos[:, None, :] - pos[cand]) ** 2, axis=-1)       # [N, 27M]
+    hit = (cand_ok & (d2 < r * r) & (cand != me[:, None])
+           & (valid[cand] > 0) & (valid[:, None] > 0))
+
+    degree = jnp.sum(hit, axis=1).astype(jnp.int32)
+    degree_overflow = jnp.any(degree > max_degree)
+
+    # valid-first, nearest-first; keep the first max_degree
+    key = jnp.where(hit, d2, big)
+    sel = jnp.argsort(key, axis=1)[:, :max_degree]                  # [N, K]
+    neighbors = jnp.take_along_axis(cand, sel, axis=1).astype(jnp.int32)
+    nbr_mask = (jnp.take_along_axis(key, sel, axis=1) < big).astype(jnp.float32)
+    neighbors = jnp.where(nbr_mask > 0, neighbors, me[:, None])
+
+    return DeviceRadiusGraph(neighbors, nbr_mask, degree,
+                             cell_overflow, degree_overflow)
+
+
+def ell_to_edge_list(g: DeviceRadiusGraph):
+    """[N, K] adjacency -> row-major edge list [2, N*K] + mask [N*K].
+
+    Row-sorted with per-node uniform slots, so for any edge_block dividing N
+    this already satisfies the blocked-layout invariant with
+    edges_per_block = K * edge_block (see ops/blocked.py)."""
+    N, K = g.neighbors.shape
+    row = jnp.repeat(jnp.arange(N, dtype=jnp.int32), K)
+    col = g.neighbors.reshape(-1)
+    return jnp.stack([row, col]), g.nbr_mask.reshape(-1)

@@ -75,7 +75,7 @@ def train(
     seed = config.seed
     is_main = jax.process_index() == 0
 
-    log_dict = {"epochs": [], "loss": [], "loss_train": []}
+    log_dict = {"epochs": [], "loss": [], "loss_train": [], "epoch_time": []}
     # epoch_index starts at start_epoch (not 0) so a checkpoint-resumed run
     # past the early_stop horizon doesn't spuriously stop before its first eval
     best = {"epoch_index": start_epoch, "loss_valid": 1e8, "loss_test": 1e8,
@@ -94,12 +94,18 @@ def train(
     start = time.perf_counter()
 
     for epoch in range(1 + start_epoch, train_cfg.epochs + 1):
+        t_epoch = time.perf_counter()
         if scan_runner is not None:
             state, loss_train = scan_runner.train_epoch(state, epoch)
             loss_train = float(loss_train)
         else:
             state, loss_train = run_epoch_train(train_step, state, loader_train, seed, epoch)
+        dt_epoch = time.perf_counter() - t_epoch
         log_dict["loss_train"].append(loss_train)
+        # observability (SURVEY §5.1/§5.5): per-epoch wall time is recorded in
+        # log.json; the fetch of loss_train above is the epoch's one host sync,
+        # so dt_epoch covers the full device time of the epoch
+        log_dict["epoch_time"].append(round(dt_epoch, 4))
 
         if epoch % log_cfg.test_interval == 0:
             if scan_runner is not None:
@@ -123,13 +129,18 @@ def train(
                       config)
                 if wandb_run is not None:
                     wandb_run.log({"loss_train": loss_train, "loss_valid": loss_valid,
-                                   "loss_test": loss_test}, step=epoch)
+                                   "loss_test": loss_test, "epoch_time": dt_epoch},
+                                  step=epoch)
+                print(f"Epoch {epoch} | train {loss_train:.5f} | "
+                      f"valid {loss_valid:.5f} | test {loss_test:.5f} | "
+                      f"{dt_epoch:.2f}s/epoch", flush=True)
                 print(f"*** Best Valid Loss: {best['loss_valid']:.5f} | "
                       f"Best Test Loss: {best['loss_test']:.5f} | "
-                      f"Best Epoch Index: {best['epoch_index']}")
+                      f"Best Epoch Index: {best['epoch_index']}", flush=True)
 
         elif is_main and log and wandb_run is not None:
-            wandb_run.log({"loss_train": loss_train}, step=epoch)
+            wandb_run.log({"loss_train": loss_train, "epoch_time": dt_epoch},
+                          step=epoch)
 
         # early stop is evaluated EVERY epoch, not only on eval epochs —
         # reference checks it at the bottom of each epoch (utils/train.py:261-267)

@@ -143,6 +143,7 @@ def main():
 
     # auto: try the kernel layout in a CHILD so a hardware/compiler surprise
     # can't kill the bench, fall back to the always-good plain path
+    fail = None
     try:
         out = subprocess.run(
             [sys.executable, os.path.abspath(__file__), "--layout", "blocked"],
@@ -158,8 +159,11 @@ def main():
                 if isinstance(rec, dict) and rec.get("metric"):
                     print(json.dumps(rec))
                     return
-    except Exception:
-        pass
+        fail = f"rc={out.returncode}, stderr tail: {out.stderr[-400:]}"
+    except Exception as e:
+        fail = repr(e)
+    print(f"bench: blocked-layout child failed ({fail}); falling back to "
+          f"layout=plain", file=sys.stderr)
     print(json.dumps(measure(0)))
 
 

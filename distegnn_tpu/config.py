@@ -113,6 +113,9 @@ _DEFAULTS: dict = {
     "log": {
         "log_dir": "./logs",
         "test_interval": 2,
+        # run parallel/checks.assert_replicated on eval epochs (the reference's
+        # startup broadcast+allclose rank check, made continuous)
+        "check_consistency": True,
         "wandb": {"enable": False, "offline": True, "api_key": "", "project": "", "entity": ""},
     },
 }

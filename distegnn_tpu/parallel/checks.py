@@ -1,0 +1,97 @@
+"""Distributed consistency checks (SURVEY §5.2; reference main.py:40-55).
+
+The reference broadcasts rank-0 weights and asserts allclose on every rank at
+startup, and all_gathers the per-rank graph signature each step
+(utils/train.py:55-61) — its defenses against rank divergence, the main
+"race" in that design. Here replication is by construction (one program,
+psum-synced grads), so divergence indicates a real bug (donation aliasing,
+sharding mistake, non-deterministic collective order, host data drift).
+These checks make the invariant EXECUTABLE rather than assumed:
+
+- :func:`assert_replicated` — every addressable shard of every param is
+  bitwise identical, and (multi-host) every process holds the same
+  fingerprint.
+- :func:`batch_fingerprint` — the per-step data-order invariant: hosts must
+  feed identical logical batches; compare fingerprints across processes.
+
+Cheap enough to run at checkpoint epochs; wired behind
+``log.check_consistency`` in the trainer.
+"""
+
+from __future__ import annotations
+
+import hashlib
+
+import jax
+import numpy as np
+
+
+def _leaf_digest(x: np.ndarray) -> bytes:
+    return hashlib.blake2b(np.ascontiguousarray(x).tobytes(), digest_size=16).digest()
+
+
+def _leaf_host_view(leaf):
+    """Host bytes of a leaf, or None for leaves no single process can see.
+
+    A multi-host REPLICATED array is not fully addressable but every process
+    holds a complete copy (its first addressable shard); a genuinely
+    cross-process-sharded leaf has no process-local full view -> skipped,
+    matching the per-device check's tolerance of distinct-index shards."""
+    if hasattr(leaf, "is_fully_addressable") and not leaf.is_fully_addressable:
+        if leaf.sharding.is_fully_replicated:
+            return np.asarray(leaf.addressable_shards[0].data)
+        return None
+    return np.asarray(leaf)
+
+
+def tree_fingerprint(tree) -> bytes:
+    """16-byte digest of every (process-visible) leaf's bytes."""
+    h = hashlib.blake2b(digest_size=16)
+    for leaf in jax.tree.leaves(tree):
+        view = _leaf_host_view(leaf)
+        if view is not None:
+            h.update(_leaf_digest(view))
+    return h.digest()
+
+
+def assert_replicated(tree, name: str = "params") -> None:
+    """Raise if any device or process holds a diverged copy of ``tree``.
+
+    Per-device: compares every addressable shard of replicated arrays
+    bitwise. Per-process (multi-host): allgathers a fingerprint and compares.
+    """
+    # cross-process compare FIRST: every process reaches the collective, so a
+    # divergence raise below cannot strand peers inside the allgather
+    if jax.process_count() > 1:
+        from jax.experimental import multihost_utils
+
+        fp = np.frombuffer(tree_fingerprint(tree), dtype=np.uint8)
+        all_fp = np.asarray(multihost_utils.process_allgather(fp))
+        if not (all_fp == all_fp[0]).all():
+            raise AssertionError(
+                f"{name} fingerprint differs across processes "
+                f"(process {jax.process_index()} of {jax.process_count()})")
+
+    for path, leaf in jax.tree_util.tree_flatten_with_path(tree)[0]:
+        if not hasattr(leaf, "addressable_shards"):
+            continue
+        # compare only shards covering the SAME global slice (replicas);
+        # distinct-index shards are genuine shards, not copies
+        by_index = {}
+        for s in leaf.addressable_shards:
+            key = tuple((sl.start, sl.stop) for sl in s.index)
+            ref = by_index.setdefault(key, s)
+            if ref is s:
+                continue
+            if not np.array_equal(np.asarray(ref.data), np.asarray(s.data),
+                                  equal_nan=True):
+                raise AssertionError(
+                    f"{name}{jax.tree_util.keystr(path)} diverged between "
+                    f"devices {ref.device} and {s.device}")
+
+
+def batch_fingerprint(batch) -> bytes:
+    """Digest of a host batch — the analog of the reference's per-step graph
+    signature all_gather (utils/train.py:55-61). Hosts feeding a lockstep
+    loader must produce identical fingerprints for the same step."""
+    return tree_fingerprint(batch)

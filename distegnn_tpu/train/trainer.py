@@ -114,6 +114,10 @@ def train(
             else:
                 loss_valid = run_epoch_eval(eval_step, state.params, loader_valid)
                 loss_test = run_epoch_eval(eval_step, state.params, loader_test)
+            if log_cfg.get("check_consistency", True):
+                from distegnn_tpu.parallel.checks import assert_replicated
+
+                assert_replicated(state.params)
             log_dict["epochs"].append(epoch)
             log_dict["loss"].append(loss_test)
 

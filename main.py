@@ -163,6 +163,8 @@ def main(argv=None):
     # per-minibatch dispatch latency that dominates small-graph training
     scan_runner = None
     flag = config.train.scan_epochs
+    if flag == "auto" and jax.default_backend() == "cpu":
+        flag = False  # local CPU has no dispatch latency; scan only adds compile
     if flag is True or flag == "auto":
         from distegnn_tpu.train.scan_epoch import ScanEpochRunner, dataset_nbytes
 

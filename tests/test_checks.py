@@ -1,0 +1,49 @@
+"""Distributed consistency checks (parallel/checks.py)."""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from distegnn_tpu.parallel.checks import assert_replicated, batch_fingerprint, tree_fingerprint
+
+
+def _mesh():
+    return Mesh(np.array(jax.devices()[:8]), ("d",))
+
+
+def test_replicated_array_passes():
+    mesh = _mesh()
+    x = jnp.arange(64.0).reshape(8, 8)
+    arr = jax.device_put(x, NamedSharding(mesh, P()))
+    assert_replicated({"w": arr})
+
+
+def test_diverged_copy_raises():
+    mesh = _mesh()
+    sharding = NamedSharding(mesh, P())
+    # a "replicated" array whose device copies disagree — exactly the failure
+    # mode the reference's broadcast+allclose check exists for
+    base = np.arange(64.0, dtype=np.float32).reshape(8, 8)
+    bufs = [jax.device_put(base + (1.0 if i == 3 else 0.0), d)
+            for i, d in enumerate(mesh.devices.flat)]
+    bad = jax.make_array_from_single_device_arrays((8, 8), sharding, bufs)
+    with pytest.raises(AssertionError, match="diverged"):
+        assert_replicated({"w": bad})
+
+
+def test_sharded_leaf_skipped():
+    mesh = _mesh()
+    x = jnp.arange(64.0).reshape(8, 8)
+    sharded = jax.device_put(x, NamedSharding(mesh, P("d")))
+    assert_replicated({"w": sharded})  # not replicated -> not checked
+
+
+def test_batch_fingerprint_is_order_sensitive():
+    a = {"x": np.arange(10.0), "y": np.ones(3)}
+    b = {"x": np.arange(10.0), "y": np.ones(3)}
+    assert batch_fingerprint(a) == batch_fingerprint(b)
+    b["x"] = b["x"][::-1].copy()
+    assert batch_fingerprint(a) != batch_fingerprint(b)
+    assert tree_fingerprint(a) == tree_fingerprint({"x": a["x"], "y": a["y"]})

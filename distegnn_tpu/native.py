@@ -23,10 +23,13 @@ _lib = None
 _build_failed = False
 
 
+_SOURCES = ("partition.cpp", "blockify.cpp")
+
+
 def _build() -> Optional[str]:
     os.makedirs(os.path.dirname(_LIB_PATH), exist_ok=True)
-    src = os.path.join(_NATIVE_DIR, "partition.cpp")
-    cmd = ["g++", "-O3", "-shared", "-fPIC", "-std=c++17", src, "-o", _LIB_PATH]
+    srcs = [os.path.join(_NATIVE_DIR, s) for s in _SOURCES]
+    cmd = ["g++", "-O3", "-shared", "-fPIC", "-std=c++17", *srcs, "-o", _LIB_PATH]
     try:
         subprocess.run(cmd, check=True, capture_output=True, timeout=120)
         return _LIB_PATH
@@ -41,40 +44,65 @@ def load_native() -> Optional[ctypes.CDLL]:
         if _lib is not None or _build_failed:
             return _lib
         try:
-            src = os.path.join(_NATIVE_DIR, "partition.cpp")
+            newest = max(os.path.getmtime(os.path.join(_NATIVE_DIR, s))
+                         for s in _SOURCES)
             if (not os.path.exists(_LIB_PATH)
-                    or os.path.getmtime(_LIB_PATH) < os.path.getmtime(src)):
+                    or os.path.getmtime(_LIB_PATH) < newest):
                 if _build() is None:
                     _build_failed = True
                     return None
-            lib = ctypes.CDLL(_LIB_PATH)
-        except OSError:
-            # stale/incompatible cached .so or missing source: rebuild once,
-            # else fall back to the numpy partitioner
+            lib = _bind(ctypes.CDLL(_LIB_PATH))
+        except (OSError, AttributeError):
+            # stale/incompatible cached .so (load failure OR missing symbols
+            # from an older build): rebuild once, else numpy fallback
             try:
                 if _build() is None:
                     raise OSError
-                lib = ctypes.CDLL(_LIB_PATH)
-            except OSError:
+                lib = _bind(ctypes.CDLL(_LIB_PATH))
+            except (OSError, AttributeError):
                 _build_failed = True
                 return None
-        lib.partition_graph.restype = ctypes.c_int
-        lib.partition_graph.argtypes = [
-            ctypes.c_int64,
-            np.ctypeslib.ndpointer(np.int64, flags="C_CONTIGUOUS"),
-            np.ctypeslib.ndpointer(np.int64, flags="C_CONTIGUOUS"),
-            ctypes.c_int32, ctypes.c_uint64,
-            np.ctypeslib.ndpointer(np.int32, flags="C_CONTIGUOUS"),
-        ]
-        lib.edge_cut.restype = ctypes.c_int64
-        lib.edge_cut.argtypes = [
-            ctypes.c_int64,
-            np.ctypeslib.ndpointer(np.int64, flags="C_CONTIGUOUS"),
-            np.ctypeslib.ndpointer(np.int64, flags="C_CONTIGUOUS"),
-            np.ctypeslib.ndpointer(np.int32, flags="C_CONTIGUOUS"),
-        ]
         _lib = lib
         return _lib
+
+
+def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
+    """Declare every exported symbol's signature (raises AttributeError on a
+    library built from older sources — caller rebuilds)."""
+    lib.partition_graph.restype = ctypes.c_int
+    lib.partition_graph.argtypes = [
+        ctypes.c_int64,
+        np.ctypeslib.ndpointer(np.int64, flags="C_CONTIGUOUS"),
+        np.ctypeslib.ndpointer(np.int64, flags="C_CONTIGUOUS"),
+        ctypes.c_int32, ctypes.c_uint64,
+        np.ctypeslib.ndpointer(np.int32, flags="C_CONTIGUOUS"),
+    ]
+    lib.edge_cut.restype = ctypes.c_int64
+    lib.edge_cut.argtypes = [
+        ctypes.c_int64,
+        np.ctypeslib.ndpointer(np.int64, flags="C_CONTIGUOUS"),
+        np.ctypeslib.ndpointer(np.int64, flags="C_CONTIGUOUS"),
+        np.ctypeslib.ndpointer(np.int32, flags="C_CONTIGUOUS"),
+    ]
+    lib.blockify_edges_native.restype = ctypes.c_int
+    lib.blockify_edges_native.argtypes = [
+        ctypes.c_int64,
+        np.ctypeslib.ndpointer(np.int64, flags="C_CONTIGUOUS"),
+        np.ctypeslib.ndpointer(np.int64, flags="C_CONTIGUOUS"),
+        ctypes.c_void_p,  # attr (may be NULL)
+        ctypes.c_int64, ctypes.c_int64, ctypes.c_int64, ctypes.c_int64,
+        np.ctypeslib.ndpointer(np.int32, flags="C_CONTIGUOUS"),
+        np.ctypeslib.ndpointer(np.float32, flags="C_CONTIGUOUS"),
+        np.ctypeslib.ndpointer(np.float32, flags="C_CONTIGUOUS"),
+    ]
+    lib.pairing_perm_native.restype = ctypes.c_int
+    lib.pairing_perm_native.argtypes = [
+        ctypes.c_int64,
+        np.ctypeslib.ndpointer(np.int32, flags="C_CONTIGUOUS"),
+        np.ctypeslib.ndpointer(np.int32, flags="C_CONTIGUOUS"),
+        np.ctypeslib.ndpointer(np.int64, flags="C_CONTIGUOUS"),
+    ]
+    return lib
 
 
 def native_partition(indptr: np.ndarray, indices: np.ndarray, nparts: int,
@@ -101,3 +129,52 @@ def native_edge_cut(indptr: np.ndarray, indices: np.ndarray,
     return int(lib.edge_cut(n, np.ascontiguousarray(indptr, np.int64),
                             np.ascontiguousarray(indices, np.int64),
                             np.ascontiguousarray(labels, np.int32)))
+
+
+def native_blockify(edge_index: np.ndarray, edge_attr: Optional[np.ndarray],
+                    n_nodes: int, epb: int, block: int):
+    """Blocked edge re-layout via C++ (ops/blocked.blockify_edges semantics),
+    or None when the native library can't be built / input is invalid."""
+    lib = load_native()
+    if lib is None:
+        return None
+    e = edge_index.shape[1]
+    nb = n_nodes // block
+    E = nb * epb
+    d = edge_attr.shape[1] if edge_attr is not None else 0
+    out_index = np.empty((2, E), np.int32)
+    # d == 0: C++ never touches out_attr, a 1-element dummy satisfies ctypes
+    out_attr = np.zeros((E, d) if d else (1, 1), np.float32)
+    out_mask = np.empty((E,), np.float32)
+    row = np.ascontiguousarray(edge_index[0], np.int64)
+    col = np.ascontiguousarray(edge_index[1], np.int64)
+    # keep the contiguous attr alive across the call (a bare .ctypes.data of
+    # a temporary would dangle)
+    attr_arr = np.ascontiguousarray(edge_attr, np.float32) if d else None
+    rc = lib.blockify_edges_native(
+        e, row, col, attr_arr.ctypes.data if d else None, d, n_nodes, block,
+        epb, out_index, out_attr, out_mask)
+    if rc != 0:
+        return None
+    return out_index, out_attr if d else np.zeros((E, 0), np.float32), out_mask
+
+
+def native_pairing(edge_index: np.ndarray):
+    """Reverse-edge involution via C++ (ops/blocked.pairing_perm semantics).
+
+    Tri-state: ndarray (valid permutation) | False (definitively asymmetric)
+    | None (native unavailable or ids out of packing range — use the numpy
+    path). Prefer ops/blocked.pairing_perm_fast, which folds the dispatch."""
+    lib = load_native()
+    if lib is None:
+        return None
+    e = edge_index.shape[1]
+    pair = np.empty((e,), np.int64)
+    rc = lib.pairing_perm_native(
+        e, np.ascontiguousarray(edge_index[0], np.int32),
+        np.ascontiguousarray(edge_index[1], np.int32), pair)
+    if rc == 0:
+        return pair
+    if rc == 1:
+        return False           # definitively not symmetric
+    return None                # out of packing range: caller uses numpy

@@ -124,6 +124,18 @@ def pairing_perm(edge_index: np.ndarray) -> Optional[np.ndarray]:
     return pair
 
 
+def pairing_perm_fast(edge_index: np.ndarray) -> Optional[np.ndarray]:
+    """:func:`pairing_perm` through the native fast path when available
+    (native/blockify.cpp), numpy otherwise. Same contract: a verified
+    reverse-edge permutation, or None when the list isn't symmetric."""
+    from distegnn_tpu.native import native_pairing
+
+    pair = native_pairing(edge_index)
+    if pair is None:
+        return pairing_perm(edge_index)
+    return None if pair is False else pair
+
+
 def prepare_blocked_graph(g: dict, n_nodes_padded: int, epb: int, block: int,
                           compute_pair: bool = True) -> dict:
     """Blockify one graph dict in place-of (returns a copy): row-sort if
@@ -139,10 +151,19 @@ def prepare_blocked_graph(g: dict, n_nodes_padded: int, epb: int, block: int,
         g["edge_index"] = g["edge_index"][:, order]
         if g.get("edge_attr") is not None:
             g["edge_attr"] = g["edge_attr"][order]
-    ei, ea, em = blockify_edges(g["edge_index"].astype(np.int64),
-                                g.get("edge_attr"), n_nodes_padded, epb, block)
+    # native fast path (native/blockify.cpp) with the numpy implementation as
+    # the universal fallback — identical layout either way
+    from distegnn_tpu.native import native_blockify
+
+    nat = native_blockify(g["edge_index"].astype(np.int64),
+                          g.get("edge_attr"), n_nodes_padded, epb, block)
+    if nat is not None:
+        ei, ea, em = nat
+    else:
+        ei, ea, em = blockify_edges(g["edge_index"].astype(np.int64),
+                                    g.get("edge_attr"), n_nodes_padded, epb, block)
     g["edge_index"], g["edge_attr"], g["_edge_mask"] = ei, ea, em
-    g["_edge_pair"] = pairing_perm(ei) if compute_pair else None
+    g["_edge_pair"] = pairing_perm_fast(ei) if compute_pair else None
     g["_blockified"] = stamp
     return g
 
@@ -155,7 +176,7 @@ def scan_dataset_for_blocking(dataset, n_nodes_padded: int, block: int):
     for i in range(len(dataset)):
         ei = dataset[i]["edge_index"]
         deg = max(deg, max_block_degree(np.sort(ei[0]), n_nodes_padded, block))
-        symmetric = symmetric and pairing_perm(ei) is not None
+        symmetric = symmetric and pairing_perm_fast(ei) is not None
     return deg, symmetric
 
 

@@ -52,3 +52,38 @@ def test_degenerate_small_region():
     pos = np.random.default_rng(0).normal(size=(3, 3))
     labels = metis_labels(pos, 4, outer_radius=5.0)
     assert sorted(labels.tolist()) == [0, 1, 2]
+
+
+def test_native_blockify_matches_numpy():
+    from distegnn_tpu.native import native_blockify, native_pairing
+    from distegnn_tpu.ops.blocked import blockify_edges, pairing_perm
+
+    rng = np.random.default_rng(11)
+    N, block, epb = 1024, 256, 2048
+    e = 5000
+    row = np.sort(rng.integers(0, N - 50, e)).astype(np.int64)
+    col = rng.integers(0, N, e).astype(np.int64)
+    ei = np.stack([row, col])
+    ea = rng.normal(size=(e, 3)).astype(np.float32)
+
+    nat = native_blockify(ei, ea, N, epb, block)
+    if nat is None:
+        import pytest
+        pytest.skip("no compiler: native path unavailable")
+    ei_n, ea_n, em_n = nat
+    ei_p, ea_p, em_p = blockify_edges(ei, ea, N, epb, block)
+    np.testing.assert_array_equal(ei_n, ei_p)
+    np.testing.assert_array_equal(em_n, em_p)
+    np.testing.assert_array_equal(ea_n, ea_p)
+
+    # pairing on a symmetric list: both find a VALID involution
+    sym = np.concatenate([ei_p, ei_p[::-1]], axis=1)
+    pair = native_pairing(sym)
+    assert pair is not None and pair is not False
+    assert np.array_equal(sym[0][pair], sym[1])
+    assert np.array_equal(sym[1][pair], sym[0])
+    # asymmetric -> detected
+    assert native_pairing(np.array([[0, 1], [1, 2]])) is False
+    # numpy agrees on both verdicts
+    assert pairing_perm(sym) is not None
+    assert pairing_perm(np.array([[0, 1], [1, 2]])) is None

@@ -63,6 +63,11 @@ _DEFAULTS: dict = {
         "node_attr_nf": 0,
         "edge_attr_nf": 2,
         "checkpoint": None,
+        # TPU knobs: 'bf16' runs invariant-channel MLPs at MXU-native
+        # precision (geometry stays f32 — see docs/PERFORMANCE.md); remat
+        # recomputes each layer in backward, trading FLOPs for HBM headroom
+        "compute_dtype": None,
+        "remat": False,
     },
     "data": {
         "data_dir": "./data",

@@ -214,6 +214,11 @@ class FastEGNN(nn.Module):
     gravity: Optional[Tuple[float, float, float]] = None
     axis_name: Optional[str] = None
     compute_dtype: Optional[str] = None  # 'bf16' -> MXU-native message MLPs
+    # recompute each layer's activations in the backward pass instead of
+    # keeping them in HBM: layer activations are O(E*H) (hundreds of MB at
+    # LargeFluid scale), so remat trades cheap recompute FLOPs for the
+    # memory that bounds graph size / batch per chip (jax.checkpoint)
+    remat: bool = False
 
     @nn.compact
     def __call__(self, g: GraphBatch) -> Tuple[jnp.ndarray, jnp.ndarray]:
@@ -240,8 +245,9 @@ class FastEGNN(nn.Module):
                                       g.max_nodes, g.edge_block, g.edge_tile)
             inv_deg = 1.0 / jnp.maximum(deg, 1.0)
 
+        layer_cls = nn.remat(EGCLVel) if self.remat else EGCLVel
         for i in range(self.n_layers):
-            h, x, Hv, X = EGCLVel(
+            h, x, Hv, X = layer_cls(
                 hidden_nf=H,
                 virtual_channels=C,
                 node_attr_nf=self.node_attr_nf,

@@ -46,6 +46,8 @@ def get_model(model_config, world_size: int = 1, dataset_name: Optional[str] = N
             normalize=model_config.normalize,
             gravity=None,
             axis_name=axis_name,
+            compute_dtype=model_config.get("compute_dtype"),
+            remat=bool(model_config.get("remat", False)),
         )
     if name == "FastRF":
         FastRF = _import_model("fast_rf", "FastRF")

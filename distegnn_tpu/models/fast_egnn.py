@@ -23,11 +23,9 @@ import jax
 import jax.numpy as jnp
 from flax import linen as nn
 
-from distegnn_tpu.models.common import MLP, CoordMLP, TorchDense, gather_nodes, resolve_dtype
-from distegnn_tpu.ops.blocked import (blocked_gather, blocked_segment_sum,
-                                      paired_col_gather, slot_ids)
+from distegnn_tpu.models.common import MLP, CoordMLP, TorchDense, resolve_dtype
+from distegnn_tpu.ops.blocked import EdgeOps, blocked_slot_inv_deg
 from distegnn_tpu.ops.graph import GraphBatch
-from distegnn_tpu.ops.segment import segment_sum, segment_mean
 from distegnn_tpu.parallel.collectives import global_node_mean
 
 
@@ -72,38 +70,13 @@ class EGCLVel(nn.Module):
     ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray, jnp.ndarray]:
         H, C = self.hidden_nf, self.virtual_channels
         dt = resolve_dtype(self.compute_dtype)
-        srt = g.edges_sorted
-        row, col = g.row, g.col                      # [B, E]
         node_mask = g.node_mask                      # [B, N]
         edge_mask = g.edge_mask                      # [B, E]
         nm = node_mask[..., None]
-        blocked = slot is not None  # MXU one-hot kernels (ops/blocked.py)
-        N = h.shape[1]
-
-        def gather_rows(data):
-            """data[b, row[b, e]] — block-local MXU gather when blocked."""
-            if blocked:
-                return blocked_gather(data, slot, g.edge_block, g.edge_tile)
-            return gather_nodes(data, row)
-
-        def gather_cols(data):
-            """data[b, col[b, e]]; on symmetric blocked graphs the backward
-            aggregation rides the reverse-edge permutation + MXU kernel."""
-            if blocked and g.edge_pair is not None:
-                return paired_col_gather(data, col, g.edge_pair, slot,
-                                         g.edge_block, g.edge_tile)
-            return gather_nodes(data, col)
-
-        def agg_rows_mean(data):
-            """Per-destination mean over real edges (count clamped >= 1)."""
-            if blocked:
-                return (blocked_segment_sum(data, slot, N, g.edge_block, g.edge_tile)
-                        * inv_deg).astype(data.dtype)
-            return jax.vmap(lambda t, r, m: segment_mean(
-                t, r, N, mask=m, indices_are_sorted=srt))(data, row, edge_mask)
+        ops = EdgeOps(g, slot, inv_deg)  # MXU one-hot kernels when blocked
 
         # --- real-edge geometry (reference coord2radial, :237-246)
-        coord_diff = gather_rows(x) - gather_cols(x)                    # [B, E, 3]
+        coord_diff = ops.gather_rows(x) - ops.gather_cols(x)            # [B, E, 3]
         radial = jnp.sum(coord_diff**2, axis=-1, keepdims=True)         # [B, E, 1]
         if self.normalize:
             norm = jax.lax.stop_gradient(jnp.sqrt(radial)) + self.epsilon
@@ -114,7 +87,7 @@ class EGCLVel(nn.Module):
         virtual_radial = jnp.linalg.norm(vcd, axis=2, keepdims=True)    # [B, N, 1, C]
 
         # --- real edge messages phi_e (:144-150)
-        e_in = [gather_rows(h), gather_cols(h), radial]
+        e_in = [ops.gather_rows(h), ops.gather_cols(h), radial]
         if self.edge_attr_nf:
             e_in.append(g.edge_attr)
         edge_feat = MLP([H, H], act_last=True, name="phi_e", dtype=dt)(jnp.concatenate(e_in, axis=-1))
@@ -151,13 +124,8 @@ class EGCLVel(nn.Module):
         if self.coords_agg not in ("sum", "mean"):
             raise ValueError(f"Wrong coords_agg parameter {self.coords_agg!r}")
         trans = coord_diff * CoordMLP(H, tanh=self.tanh, name="phi_x", dtype=dt)(edge_feat)  # [B, E, 3]
-        if self.coords_agg == "sum":
-            agg = (blocked_segment_sum(trans, slot, N, g.edge_block, g.edge_tile)
-                   if blocked
-                   else jax.vmap(lambda t, r, m: segment_sum(
-                       t, r, N, mask=m, indices_are_sorted=srt))(trans, row, edge_mask))
-        else:
-            agg = agg_rows_mean(trans)                                   # [B, N, 3]
+        agg = (ops.agg_rows_sum(trans) if self.coords_agg == "sum"
+               else ops.agg_rows_mean(trans))                            # [B, N, 3]
         x = x + agg
 
         phi_xv = CoordMLP(H, tanh=self.tanh, name="phi_xv", dtype=dt)(vef)  # [B, N, C, 1]
@@ -173,7 +141,7 @@ class EGCLVel(nn.Module):
         X = X + global_node_mean(trans_X, node_mask, self.axis_name)     # [B, 3, C]
 
         # --- node feature update (node_model, :203-217)
-        agg_h = agg_rows_mean(edge_feat)
+        agg_h = ops.agg_rows_mean(edge_feat)
         agg_v = jnp.mean(vef, axis=2)                                    # [B, N, H]
         n_in = [h, agg_h, agg_v]
         if self.node_attr_nf:
@@ -237,13 +205,7 @@ class FastEGNN(nn.Module):
         gravity = jnp.asarray(self.gravity, jnp.float32) if self.gravity is not None else None
 
         # blocked layout: slot ids + in-degree reciprocal, shared by all layers
-        # (row/edge_mask are layer-invariant, so one kernel pass serves L means)
-        slot = inv_deg = None
-        if g.edge_block > 0:
-            slot = slot_ids(g.row, g.edge_mask, g.edge_block, g.edges_per_block)
-            deg = blocked_segment_sum(g.edge_mask[..., None], slot,
-                                      g.max_nodes, g.edge_block, g.edge_tile)
-            inv_deg = 1.0 / jnp.maximum(deg, 1.0)
+        slot, inv_deg = blocked_slot_inv_deg(g)
 
         layer_cls = nn.remat(EGCLVel) if self.remat else EGCLVel
         for i in range(self.n_layers):

@@ -25,9 +25,9 @@ import jax
 import jax.numpy as jnp
 from flax import linen as nn
 
-from distegnn_tpu.models.common import MLP, TorchDense, coord_head_init, gather_nodes
+from distegnn_tpu.models.common import MLP, TorchDense, coord_head_init
+from distegnn_tpu.ops.blocked import EdgeOps, blocked_slot_inv_deg
 from distegnn_tpu.ops.graph import GraphBatch
-from distegnn_tpu.ops.segment import segment_mean
 from distegnn_tpu.parallel.collectives import global_node_mean
 
 _leaky = partial(nn.leaky_relu, negative_slope=0.2)
@@ -67,13 +67,14 @@ class GCLRFVel(nn.Module):
     axis_name: Optional[str] = None
 
     @nn.compact
-    def __call__(self, x, v, X, g: GraphBatch) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    def __call__(self, x, v, X, g: GraphBatch, slot=None, inv_deg=None
+                 ) -> Tuple[jnp.ndarray, jnp.ndarray]:
         H, C = self.hidden_nf, self.virtual_channels
-        row = g.row
-        node_mask, edge_mask = g.node_mask, g.edge_mask
+        node_mask = g.node_mask
         B, N = x.shape[0], x.shape[1]
+        ops = EdgeOps(g, slot, inv_deg)  # MXU one-hot kernels when blocked
 
-        coord_diff = gather_nodes(x, row) - gather_nodes(x, g.col)       # [B, E, 3]
+        coord_diff = ops.gather_rows(x) - ops.gather_cols(x)             # [B, E, 3]
         radial = jnp.sum(coord_diff**2, axis=-1, keepdims=True)          # [B, E, 1]
         vcd = X[:, None, :, :] - x[..., None]                            # [B, N, 3, C]
         virtual_radial = jnp.linalg.norm(vcd, axis=2, keepdims=True)     # [B, N, 1, C]
@@ -95,7 +96,7 @@ class GCLRFVel(nn.Module):
 
         # real coordinate update (node_model, FastRF.py:119-131)
         trans = coord_diff * _ScalarHead(H, name="edge_mlp")(edge_feat)
-        agg = jax.vmap(lambda t, r, m: segment_mean(t, r, N, mask=m))(trans, row, edge_mask)
+        agg = ops.agg_rows_mean(trans)
         trans_v = jnp.mean(-vcd * jnp.swapaxes(_ScalarHead(H, name="edge_mlp_rv")(vef), 2, 3), axis=-1)
         speed = jnp.linalg.norm(v, axis=-1, keepdims=True)
         x = x + agg + trans_v + v * MLP([H, 1], act=_leaky, name="coord_mlp_vel")(speed)
@@ -124,10 +125,11 @@ class FastRF(nn.Module):
         C = self.virtual_channels
         X = jnp.repeat(g.loc_mean[:, :, None], C, axis=2)                # [B, 3, C]
         x, v = g.loc, g.vel
+        slot, inv_deg = blocked_slot_inv_deg(g)
         for i in range(self.n_layers):
             x, X = GCLRFVel(
                 hidden_nf=self.hidden_nf, virtual_channels=C,
                 edge_attr_nf=self.edge_attr_nf, axis_name=self.axis_name,
                 name=f"gcl_{i}",
-            )(x, v, X, g)
+            )(x, v, X, g, slot=slot, inv_deg=inv_deg)
         return x, X

@@ -27,6 +27,7 @@ import jax.numpy as jnp
 from flax import linen as nn
 
 from distegnn_tpu.models.common import MLP, CoordMLP, TorchDense, gather_nodes
+from distegnn_tpu.ops.blocked import EdgeOps, blocked_slot_inv_deg
 from distegnn_tpu.models.schnet import GaussianSmearing
 from distegnn_tpu.ops.graph import GraphBatch
 from distegnn_tpu.ops.segment import segment_mean
@@ -51,24 +52,27 @@ class SchNetGCLVel(nn.Module):
     epsilon: float = 1e-8
 
     @nn.compact
-    def __call__(self, h, x, v, X, Hv, g: GraphBatch, gravity=None):
+    def __call__(self, h, x, v, X, Hv, g: GraphBatch, gravity=None,
+                 slot=None, inv_deg=None):
         H, C = self.hidden_nf, self.virtual_channels
         row, col = g.row, g.col
         node_mask, edge_mask = g.node_mask, g.edge_mask
         nm = node_mask[..., None]
         B, N = h.shape[0], h.shape[1]
+        ops = EdgeOps(g, slot, inv_deg)  # MXU one-hot kernels when blocked
 
         # normalize is accepted for config parity but is a no-op here AS IN THE
         # REFERENCE: its coord2radial normalizes coord_diff, which FastSchNet
         # then never consumes (only radial and the SchNet sublayer's raw
         # positions are used, FastSchNet.py:169-186)
-        raw_diff = gather_nodes(x, row) - gather_nodes(x, col)
+        h_row, h_col = ops.gather_rows(h), ops.gather_cols(h)
+        raw_diff = ops.gather_rows(x) - ops.gather_cols(x)
         radial = jnp.sum(raw_diff**2, axis=-1, keepdims=True)
         vcd = X[:, None, :, :] - x[..., None]                            # [B, N, 3, C]
         virtual_radial = jnp.linalg.norm(vcd, axis=2, keepdims=True)
 
         # real edge messages phi_e (FastSchNet.py:102-108)
-        e_in = [gather_nodes(h, row), gather_nodes(h, col), radial]
+        e_in = [h_row, h_col, radial]
         if self.edge_attr_nf:
             e_in.append(g.edge_attr)
         edge_feat = MLP([H, H], act_last=True, name="phi_e")(jnp.concatenate(e_in, axis=-1))
@@ -102,10 +106,8 @@ class SchNetGCLVel(nn.Module):
         edge_weight = jnp.linalg.norm(raw_diff + 1e-30, axis=-1)
         gauss = GaussianSmearing(0.0, self.cutoff, self.num_gaussians, name="smearing")(edge_weight)
         gate = TorchDense(1, name="schnet_coord_update")(
-            jnp.concatenate([gauss, gather_nodes(h, row), gather_nodes(h, col)], axis=-1))
-        agg = jax.vmap(lambda m, r, e: segment_mean(m, r, N, mask=e))(
-            raw_diff * gate, row, edge_mask)
-        x = x + agg
+            jnp.concatenate([gauss, h_row, h_col], axis=-1))
+        x = x + ops.agg_rows_mean(raw_diff * gate)
 
         # virtual pull on real nodes (phi_xv / coord_mlp_r_virtual)
         phi_xv = CoordMLP(H, tanh=self.tanh, name="phi_xv")(vef)
@@ -119,7 +121,7 @@ class SchNetGCLVel(nn.Module):
         X = X + global_node_mean(trans_X, node_mask, self.axis_name)
 
         # feature updates phi_h / phi_hv (FastSchNet.py:140-166)
-        agg_h = jax.vmap(lambda t, r, m: segment_mean(t, r, N, mask=m))(edge_feat, row, edge_mask)
+        agg_h = ops.agg_rows_mean(edge_feat)
         agg_v = jnp.mean(vef, axis=2)
         n_in = [h, agg_h, agg_v]
         if self.node_attr_nf:
@@ -166,6 +168,8 @@ class FastSchNet(nn.Module):
         x, v = g.loc, g.vel
         gravity = jnp.asarray(self.gravity, jnp.float32) if self.gravity is not None else None
 
+        slot, inv_deg = blocked_slot_inv_deg(g)
+
         for i in range(self.n_layers):
             h, x, Hv, X = SchNetGCLVel(
                 hidden_nf=H, virtual_channels=C,
@@ -174,5 +178,5 @@ class FastSchNet(nn.Module):
                 attention=self.attention, normalize=self.normalize,
                 tanh=self.tanh, has_gravity=self.gravity is not None,
                 axis_name=self.axis_name, name=f"gcl_{i}",
-            )(h, x, v, X, Hv, g, gravity=gravity)
+            )(h, x, v, X, Hv, g, gravity=gravity, slot=slot, inv_deg=inv_deg)
         return x, X

@@ -367,6 +367,66 @@ def blocked_segment_sum(data, slot, num_segments: int, block: int = DEFAULT_BLOC
     return jax.vmap(lambda d, s: _seg_sum(d, s, num_segments, block, tile))(data, slot)
 
 
+def blocked_slot_inv_deg(g):
+    """(slot ids, 1/max(in-degree,1)) for a blocked GraphBatch, or
+    (None, None) when g is not blocked. Wrappers call this ONCE per forward —
+    row/edge_mask are layer-invariant, so one kernel pass serves L layers."""
+    if g.edge_block <= 0:
+        return None, None
+    slot = slot_ids(g.row, g.edge_mask, g.edge_block, g.edges_per_block)
+    deg = blocked_segment_sum(g.edge_mask[..., None], slot, g.max_nodes,
+                              g.edge_block, g.edge_tile)
+    return slot, 1.0 / jnp.maximum(deg, 1.0)
+
+
+class EdgeOps:
+    """The one definition of the blocked-vs-XLA edge-op dispatch all model
+    families share: row/col gathers and per-destination aggregations, as MXU
+    one-hot kernels when the batch carries the blocked layout (with the
+    reverse-edge pairing backward when available), XLA sorted-scatter
+    otherwise. ``slot``/``inv_deg`` come from :func:`blocked_slot_inv_deg`
+    (hoisted once per forward; plain arrays, so layers stay remat-able)."""
+
+    def __init__(self, g, slot=None, inv_deg=None):
+        self.g, self.slot, self.inv_deg = g, slot, inv_deg
+        self.blocked = slot is not None
+
+    def gather_rows(self, data):
+        if self.blocked:
+            return blocked_gather(data, self.slot, self.g.edge_block,
+                                  self.g.edge_tile)
+        return jnp.take_along_axis(data, self.g.row[..., None], axis=1)
+
+    def gather_cols(self, data):
+        g = self.g
+        if self.blocked and g.edge_pair is not None:
+            return paired_col_gather(data, g.col, g.edge_pair, self.slot,
+                                     g.edge_block, g.edge_tile)
+        return jnp.take_along_axis(data, g.col[..., None], axis=1)
+
+    def _agg(self, data, mean: bool):
+        from distegnn_tpu.ops.segment import segment_mean, segment_sum
+
+        g = self.g
+        N = g.max_nodes
+        if self.blocked:
+            out = blocked_segment_sum(data, self.slot, N, g.edge_block, g.edge_tile)
+            if mean:
+                out = out * self.inv_deg
+            return out.astype(data.dtype)
+        seg = segment_mean if mean else segment_sum
+        return jax.vmap(lambda t, r, m: seg(
+            t, r, N, mask=m, indices_are_sorted=g.edges_sorted))(
+            data, g.row, g.edge_mask)
+
+    def agg_rows_mean(self, data):
+        """Per-destination mean over real edges (count clamped >= 1)."""
+        return self._agg(data, mean=True)
+
+    def agg_rows_sum(self, data):
+        return self._agg(data, mean=False)
+
+
 def blocked_gather(h, slot, block: int = DEFAULT_BLOCK, tile: int = DEFAULT_EDGE_TILE):
     """Batched [B, N, F] -> [B, E, F]; rows fetched block-locally (masked
     slots read as 0). Adjoint of :func:`blocked_segment_sum`."""

@@ -207,3 +207,42 @@ def test_remat_same_outputs_and_grads(edge_block):
     g0 = ravel_pytree(jax.grad(lambda p: loss(m0, p))(params))[0]
     g1 = ravel_pytree(jax.grad(lambda p: loss(m1, p))(params))[0]
     np.testing.assert_allclose(g1, g0, atol=1e-6)
+
+
+@pytest.mark.parametrize("model_name", ["FastRF", "FastSchNet"])
+def test_other_fast_models_blocked_parity(model_name):
+    """FastRF / FastSchNet: blocked layout == plain layout (fwd + grads)."""
+    from jax.flatten_util import ravel_pytree
+
+    rng = np.random.default_rng(10)
+    graphs = _nbody_like_graphs(rng)
+    plain = pad_graphs([dict(g) for g in graphs])
+    blocked = pad_graphs([dict(g) for g in graphs], edge_block=BLOCK)
+    assert blocked.edge_pair is not None
+
+    if model_name == "FastRF":
+        from distegnn_tpu.models.fast_rf import FastRF
+
+        model = FastRF(edge_attr_nf=2, hidden_nf=16, virtual_channels=2, n_layers=2)
+    else:
+        from distegnn_tpu.models.fast_schnet import FastSchNet
+
+        model = FastSchNet(node_feat_nf=1, edge_attr_nf=2, hidden_nf=16,
+                           virtual_channels=2, n_layers=2, cutoff=2.0)
+    params = model.init(jax.random.PRNGKey(0), plain)
+
+    xp, Xp = model.apply(params, plain)
+    xb, Xb = model.apply(params, blocked)
+    n = plain.max_nodes
+    np.testing.assert_allclose((xb * blocked.node_mask[..., None])[:, :n],
+                               xp * plain.node_mask[..., None], atol=1e-5)
+    np.testing.assert_allclose(Xb, Xp, atol=1e-5)
+
+    def loss(p, g):
+        x, _ = model.apply(p, g)
+        return jnp.sum((x - g.target) ** 2 * g.node_mask[..., None])
+
+    gp = ravel_pytree(jax.grad(loss)(params, plain))[0]
+    gb = ravel_pytree(jax.grad(loss)(params, blocked))[0]
+    scale = jnp.maximum(jnp.abs(gp).max(), 1.0)
+    np.testing.assert_allclose(gb / scale, gp / scale, atol=5e-5)

@@ -75,7 +75,7 @@ def make_fluid_batch(rng, edge_block: int = 0):
     return pad_graphs([graph], **kw), n_edges
 
 
-def measure(edge_block: int):
+def measure(edge_block: int, impl: str = "einsum"):
     import jax
 
     from distegnn_tpu.models.fast_egnn import FastEGNN
@@ -86,7 +86,7 @@ def measure(edge_block: int):
 
     model = FastEGNN(node_feat_nf=3, node_attr_nf=2, edge_attr_nf=2,
                      hidden_nf=HIDDEN, virtual_channels=CHANNELS, n_layers=LAYERS,
-                     compute_dtype="bf16")
+                     compute_dtype="bf16", blocked_impl=impl)
     params = model.init(jax.random.PRNGKey(0), batch)
     tx = make_optimizer(5e-4, weight_decay=1e-12, clip_norm=0.3)
     state = TrainState.create(params, tx)
@@ -117,7 +117,7 @@ def measure(edge_block: int):
 
     nodes_per_sec = N_NODES * STEPS / dt
     platform = jax.devices()[0].platform
-    layout = f"blocked{edge_block}" if edge_block else "plain"
+    layout = f"blocked{edge_block}-{impl}" if edge_block else "plain"
     official = N_NODES == 113_140  # vs_baseline is meaningless off-workload
     return {
         "metric": "largefluid_train_nodes_per_sec_per_chip",
@@ -129,24 +129,43 @@ def measure(edge_block: int):
 
 
 def main():
+    # BENCH_PLATFORM=cpu pins the backend for smoke tests — NOTE env var
+    # JAX_PLATFORMS alone is not enough on axon-tunnel hosts (the tunnel
+    # plugin's get_backend hook initializes every discovered platform and a
+    # wedged tunnel then hangs the process); config.update is honored.
+    plat = os.environ.get("BENCH_PLATFORM")
+    if plat:
+        import jax
+
+        jax.config.update("jax_platforms", plat)
+
     args = sys.argv[1:]
-    layout = "auto"
+    layout, impl = "auto", "einsum"
     if "--layout" in args:
         i = args.index("--layout")
         if i + 1 >= len(args) or args[i + 1] not in ("plain", "blocked", "auto"):
-            sys.exit("usage: bench.py [--layout plain|blocked|auto]")
+            sys.exit("usage: bench.py [--layout plain|blocked|auto] [--impl pallas|einsum]")
         layout = args[i + 1]
+    if "--impl" in args:
+        i = args.index("--impl")
+        if i + 1 >= len(args) or args[i + 1] not in ("pallas", "einsum"):
+            sys.exit("usage: bench.py [--layout plain|blocked|auto] [--impl pallas|einsum]")
+        impl = args[i + 1]
 
     if layout in ("plain", "blocked"):
-        print(json.dumps(measure(256 if layout == "blocked" else 0)))
+        print(json.dumps(measure(256 if layout == "blocked" else 0, impl)))
         return
 
-    # auto: try the kernel layout in a CHILD so a hardware/compiler surprise
-    # can't kill the bench, fall back to the always-good plain path
+    # auto: try the blocked layout in a CHILD so a compiler surprise on new
+    # hardware can't kill the bench; fall back to plain. Default impl is the
+    # einsum lowering: the Pallas kernels hardware-measured SLOWER than plain
+    # (1067.7 vs ~712-773 ms/step, BASELINE.md round-2 status) — grid-step
+    # overhead swamps the tiny per-step dots at this shape.
     fail = None
     try:
         out = subprocess.run(
-            [sys.executable, os.path.abspath(__file__), "--layout", "blocked"],
+            [sys.executable, os.path.abspath(__file__),
+             "--layout", "blocked", "--impl", impl],
             capture_output=True, text=True, timeout=CHILD_TIMEOUT_S,
             cwd=os.path.dirname(os.path.abspath(__file__)),
         )

@@ -68,6 +68,11 @@ _DEFAULTS: dict = {
         # recomputes each layer in backward, trading FLOPs for HBM headroom
         "compute_dtype": None,
         "remat": False,
+        # lowering of the blocked edge ops (only used when data.edge_block>0):
+        # 'einsum' (one-hot materialized once per forward, aggregations and
+        # gathers become batched MXU dots — default) or 'pallas' (one-hot
+        # built in VMEM per kernel) — see ops/blocked.py
+        "blocked_impl": "einsum",
     },
     "data": {
         "data_dir": "./data",

@@ -67,13 +67,14 @@ class EGCLVel(nn.Module):
         gravity: Optional[jnp.ndarray] = None,  # [3]
         slot: Optional[jnp.ndarray] = None,     # [B, E] blocked-layout slots
         inv_deg: Optional[jnp.ndarray] = None,  # [B, N, 1] 1/max(in-degree, 1)
+        oh: Optional[jnp.ndarray] = None,       # [B, nb, epb, block] einsum incidence
     ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray, jnp.ndarray]:
         H, C = self.hidden_nf, self.virtual_channels
         dt = resolve_dtype(self.compute_dtype)
         node_mask = g.node_mask                      # [B, N]
         edge_mask = g.edge_mask                      # [B, E]
         nm = node_mask[..., None]
-        ops = EdgeOps(g, slot, inv_deg)  # MXU one-hot kernels when blocked
+        ops = EdgeOps(g, slot, inv_deg, oh)  # MXU one-hot contractions when blocked
 
         # --- real-edge geometry (reference coord2radial, :237-246)
         coord_diff = ops.gather_rows(x) - ops.gather_cols(x)            # [B, E, 3]
@@ -182,6 +183,11 @@ class FastEGNN(nn.Module):
     gravity: Optional[Tuple[float, float, float]] = None
     axis_name: Optional[str] = None
     compute_dtype: Optional[str] = None  # 'bf16' -> MXU-native message MLPs
+    # lowering of the blocked-layout edge ops (used only when the batch
+    # carries edge_block > 0): 'einsum' = one-hot materialized once per
+    # forward, ops are batched dots (default — no Pallas grid overhead);
+    # 'pallas' = one-hot built in VMEM per kernel
+    blocked_impl: str = "einsum"
     # recompute each layer's activations in the backward pass instead of
     # keeping them in HBM: layer activations are O(E*H) (hundreds of MB at
     # LargeFluid scale), so remat trades cheap recompute FLOPs for the
@@ -204,8 +210,9 @@ class FastEGNN(nn.Module):
         x, v = g.loc, g.vel
         gravity = jnp.asarray(self.gravity, jnp.float32) if self.gravity is not None else None
 
-        # blocked layout: slot ids + in-degree reciprocal, shared by all layers
-        slot, inv_deg = blocked_slot_inv_deg(g)
+        # blocked layout: slot ids + in-degree reciprocal (+ einsum incidence),
+        # shared by all layers
+        slot, inv_deg, oh = blocked_slot_inv_deg(g, self.blocked_impl)
 
         layer_cls = nn.remat(EGCLVel) if self.remat else EGCLVel
         for i in range(self.n_layers):
@@ -222,6 +229,7 @@ class FastEGNN(nn.Module):
                 axis_name=self.axis_name,
                 compute_dtype=self.compute_dtype,
                 name=f"gcl_{i}",
-            )(h, x, v, X, Hv, g, gravity=gravity, slot=slot, inv_deg=inv_deg)
+            )(h, x, v, X, Hv, g, gravity=gravity, slot=slot, inv_deg=inv_deg,
+              oh=oh)
 
         return x, X

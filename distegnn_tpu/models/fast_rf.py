@@ -67,12 +67,12 @@ class GCLRFVel(nn.Module):
     axis_name: Optional[str] = None
 
     @nn.compact
-    def __call__(self, x, v, X, g: GraphBatch, slot=None, inv_deg=None
+    def __call__(self, x, v, X, g: GraphBatch, slot=None, inv_deg=None, oh=None
                  ) -> Tuple[jnp.ndarray, jnp.ndarray]:
         H, C = self.hidden_nf, self.virtual_channels
         node_mask = g.node_mask
         B, N = x.shape[0], x.shape[1]
-        ops = EdgeOps(g, slot, inv_deg)  # MXU one-hot kernels when blocked
+        ops = EdgeOps(g, slot, inv_deg, oh)  # MXU one-hot contractions when blocked
 
         coord_diff = ops.gather_rows(x) - ops.gather_cols(x)             # [B, E, 3]
         radial = jnp.sum(coord_diff**2, axis=-1, keepdims=True)          # [B, E, 1]
@@ -118,6 +118,7 @@ class FastRF(nn.Module):
     virtual_channels: int = 3
     n_layers: int = 4
     axis_name: Optional[str] = None
+    blocked_impl: str = "einsum"  # blocked-layout edge-op lowering ('pallas'|'einsum')
 
     @nn.compact
     def __call__(self, g: GraphBatch) -> Tuple[jnp.ndarray, jnp.ndarray]:
@@ -125,11 +126,11 @@ class FastRF(nn.Module):
         C = self.virtual_channels
         X = jnp.repeat(g.loc_mean[:, :, None], C, axis=2)                # [B, 3, C]
         x, v = g.loc, g.vel
-        slot, inv_deg = blocked_slot_inv_deg(g)
+        slot, inv_deg, oh = blocked_slot_inv_deg(g, self.blocked_impl)
         for i in range(self.n_layers):
             x, X = GCLRFVel(
                 hidden_nf=self.hidden_nf, virtual_channels=C,
                 edge_attr_nf=self.edge_attr_nf, axis_name=self.axis_name,
                 name=f"gcl_{i}",
-            )(x, v, X, g, slot=slot, inv_deg=inv_deg)
+            )(x, v, X, g, slot=slot, inv_deg=inv_deg, oh=oh)
         return x, X

@@ -26,11 +26,10 @@ import jax
 import jax.numpy as jnp
 from flax import linen as nn
 
-from distegnn_tpu.models.common import MLP, CoordMLP, TorchDense, gather_nodes
+from distegnn_tpu.models.common import MLP, CoordMLP, TorchDense
 from distegnn_tpu.ops.blocked import EdgeOps, blocked_slot_inv_deg
 from distegnn_tpu.models.schnet import GaussianSmearing
 from distegnn_tpu.ops.graph import GraphBatch
-from distegnn_tpu.ops.segment import segment_mean
 from distegnn_tpu.parallel.collectives import global_node_mean
 
 
@@ -53,13 +52,12 @@ class SchNetGCLVel(nn.Module):
 
     @nn.compact
     def __call__(self, h, x, v, X, Hv, g: GraphBatch, gravity=None,
-                 slot=None, inv_deg=None):
+                 slot=None, inv_deg=None, oh=None):
         H, C = self.hidden_nf, self.virtual_channels
-        row, col = g.row, g.col
         node_mask, edge_mask = g.node_mask, g.edge_mask
         nm = node_mask[..., None]
         B, N = h.shape[0], h.shape[1]
-        ops = EdgeOps(g, slot, inv_deg)  # MXU one-hot kernels when blocked
+        ops = EdgeOps(g, slot, inv_deg, oh)  # MXU one-hot contractions when blocked
 
         # normalize is accepted for config parity but is a no-op here AS IN THE
         # REFERENCE: its coord2radial normalizes coord_diff, which FastSchNet
@@ -153,6 +151,7 @@ class FastSchNet(nn.Module):
     tanh: bool = False
     gravity: Optional[Tuple[float, float, float]] = None
     axis_name: Optional[str] = None
+    blocked_impl: str = "einsum"  # blocked-layout edge-op lowering ('pallas'|'einsum')
 
     @nn.compact
     def __call__(self, g: GraphBatch) -> Tuple[jnp.ndarray, jnp.ndarray]:
@@ -168,7 +167,7 @@ class FastSchNet(nn.Module):
         x, v = g.loc, g.vel
         gravity = jnp.asarray(self.gravity, jnp.float32) if self.gravity is not None else None
 
-        slot, inv_deg = blocked_slot_inv_deg(g)
+        slot, inv_deg, oh = blocked_slot_inv_deg(g, self.blocked_impl)
 
         for i in range(self.n_layers):
             h, x, Hv, X = SchNetGCLVel(
@@ -178,5 +177,6 @@ class FastSchNet(nn.Module):
                 attention=self.attention, normalize=self.normalize,
                 tanh=self.tanh, has_gravity=self.gravity is not None,
                 axis_name=self.axis_name, name=f"gcl_{i}",
-            )(h, x, v, X, Hv, g, gravity=gravity, slot=slot, inv_deg=inv_deg)
+            )(h, x, v, X, Hv, g, gravity=gravity, slot=slot, inv_deg=inv_deg,
+              oh=oh)
         return x, X

@@ -48,6 +48,7 @@ def get_model(model_config, world_size: int = 1, dataset_name: Optional[str] = N
             axis_name=axis_name,
             compute_dtype=model_config.get("compute_dtype"),
             remat=bool(model_config.get("remat", False)),
+            blocked_impl=model_config.get("blocked_impl", "einsum"),
         )
     if name == "FastRF":
         FastRF = _import_model("fast_rf", "FastRF")
@@ -57,6 +58,7 @@ def get_model(model_config, world_size: int = 1, dataset_name: Optional[str] = N
             n_layers=model_config.n_layers,
             virtual_channels=model_config.virtual_channels,
             axis_name=axis_name,
+            blocked_impl=model_config.get("blocked_impl", "einsum"),
         )
     if name in ("FastSchNet", "SchNet"):
         cutoff = _SCHNET_CUTOFFS.get(dataset_name)
@@ -74,6 +76,7 @@ def get_model(model_config, world_size: int = 1, dataset_name: Optional[str] = N
                 normalize=model_config.normalize,
                 cutoff=cutoff,
                 axis_name=axis_name,
+                blocked_impl=model_config.get("blocked_impl", "einsum"),
             )
         SchNet = _import_model("schnet", "SchNet")
         return SchNet(hidden_channels=model_config.hidden_nf, cutoff=cutoff)

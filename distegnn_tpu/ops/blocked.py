@@ -357,6 +357,134 @@ _paired_gather.defvjp(_paired_gather_fwd, _paired_gather_bwd)
 
 
 # ---------------------------------------------------------------------------
+# Einsum lowering of the same blocked contraction (impl='einsum')
+#
+# Identical math to the Pallas kernels, but the one-hot incidence factor is
+# MATERIALIZED once per forward as a bf16 [B, nb, epb, block] tensor and every
+# aggregation/gather is a plain batched dot XLA schedules itself. Rationale:
+# the Pallas kernels run one small (tile x block x F) MXU dot per grid step —
+# thousands of steps per call — and the first hardware run measured the
+# per-step overhead swamping the dot (BASELINE.md round-2 status). The einsum
+# form trades ~E*block*2 bytes of HBM traffic per op (abundant: ~1ms at v5e
+# bandwidth for LargeFluid) for zero grid overhead and full XLA pipelining.
+#
+# f32 exactness without an f32 one-hot: the one-hot factor is exactly
+# representable in bf16, so an f32 operand is split into 3 bf16 terms
+# (hi/mid/lo, residual ~2^-24 relative) contracted separately and summed in
+# f32 — the manual form of XLA's bf16_3x, paying 1x (not 3x) per extra
+# operand pass because the one-hot side needs no splitting.
+# ---------------------------------------------------------------------------
+
+def onehot_blocks(slot: jnp.ndarray, epb: int, block: int) -> jnp.ndarray:
+    """[..., E] slot ids (from :func:`slot_ids`) -> [..., nb, epb, block] bf16
+    one-hot incidence. Sentinel slots (== block) match no column and vanish."""
+    E = slot.shape[-1]
+    nb = E // epb
+    s = slot.reshape(slot.shape[:-1] + (nb, epb))
+    return (s[..., None] == jnp.arange(block, dtype=jnp.int32)).astype(jnp.bfloat16)
+
+
+def _bf16_terms(x: jnp.ndarray, n_terms: int = 3):
+    """Split x into bf16 terms summing to x up to ~2^-24 relative error.
+    bf16 input passes through unsplit."""
+    if x.dtype == jnp.bfloat16:
+        return [x]
+    terms = []
+    rem = x.astype(jnp.float32)
+    for _ in range(n_terms - 1):
+        t = rem.astype(jnp.bfloat16)
+        terms.append(t)
+        rem = rem - t.astype(jnp.float32)
+    terms.append(rem.astype(jnp.bfloat16))
+    return terms
+
+
+def _ein_seg_sum_raw(data: jnp.ndarray, oh: jnp.ndarray) -> jnp.ndarray:
+    """[..., E, F] x [..., nb, epb, block] -> [..., nb*block, F] float32."""
+    *lead, E, F = data.shape
+    nb, epb, block = oh.shape[-3:]
+    d = data.reshape(*lead, nb, epb, F)
+    out = None
+    for t in _bf16_terms(d):
+        part = jnp.einsum("...bek,...bef->...bkf", oh, t,
+                          preferred_element_type=jnp.float32)
+        out = part if out is None else out + part
+    return out.reshape(*lead, nb * block, F)
+
+
+def _ein_gather_raw(h: jnp.ndarray, oh: jnp.ndarray) -> jnp.ndarray:
+    """[..., N, F] x [..., nb, epb, block] -> [..., E, F] float32 (blocked row
+    gather; sentinel slots read as 0)."""
+    *lead, N, F = h.shape
+    nb, epb, block = oh.shape[-3:]
+    hh = h.reshape(*lead, nb, block, F)
+    out = None
+    for t in _bf16_terms(hh):
+        part = jnp.einsum("...bek,...bkf->...bef", oh, t,
+                          preferred_element_type=jnp.float32)
+        out = part if out is None else out + part
+    return out.reshape(*lead, nb * epb, F)
+
+
+# The raw forms are exact adjoints, but differentiating THROUGH the bf16 term
+# split would bf16-round the cotangent (the transpose of an f32->bf16 cast
+# rounds); these custom_vjps instead apply the split to the cotangent itself,
+# keeping gradients f32-accurate — and, as with the Pallas pair, guaranteeing
+# the backward pass contains no scatter.
+
+@jax.custom_vjp
+def einsum_segment_sum(data, oh):
+    return _ein_seg_sum_raw(data, oh)
+
+
+def _ein_seg_sum_fwd(data, oh):
+    return _ein_seg_sum_raw(data, oh), (oh, jnp.zeros((), data.dtype))
+
+
+def _ein_seg_sum_bwd(res, g):
+    oh, proto = res
+    return _ein_gather_raw(g, oh).astype(proto.dtype), None
+
+
+einsum_segment_sum.defvjp(_ein_seg_sum_fwd, _ein_seg_sum_bwd)
+
+
+@jax.custom_vjp
+def einsum_gather(h, oh):
+    return _ein_gather_raw(h, oh).astype(h.dtype)
+
+
+def _ein_gather_fwd(h, oh):
+    return _ein_gather_raw(h, oh).astype(h.dtype), (oh, jnp.zeros((), h.dtype))
+
+
+def _ein_gather_bwd(res, g):
+    oh, proto = res
+    return _ein_seg_sum_raw(g, oh).astype(proto.dtype), None
+
+
+einsum_gather.defvjp(_ein_gather_fwd, _ein_gather_bwd)
+
+
+@jax.custom_vjp
+def _paired_gather_ein(h, col, pair, oh):
+    return jnp.take(h, col, axis=0)
+
+
+def _paired_gather_ein_fwd(h, col, pair, oh):
+    return jnp.take(h, col, axis=0), (pair, oh, jnp.zeros((), h.dtype))
+
+
+def _paired_gather_ein_bwd(res, g):
+    pair, oh, proto = res
+    grad_h = _ein_seg_sum_raw(jnp.take(g, pair, axis=0), oh)
+    return grad_h.astype(proto.dtype), None, None, None
+
+
+_paired_gather_ein.defvjp(_paired_gather_ein_fwd, _paired_gather_ein_bwd)
+
+
+# ---------------------------------------------------------------------------
 # Public batched API (mirrors ops.segment signatures)
 # ---------------------------------------------------------------------------
 
@@ -367,16 +495,28 @@ def blocked_segment_sum(data, slot, num_segments: int, block: int = DEFAULT_BLOC
     return jax.vmap(lambda d, s: _seg_sum(d, s, num_segments, block, tile))(data, slot)
 
 
-def blocked_slot_inv_deg(g):
-    """(slot ids, 1/max(in-degree,1)) for a blocked GraphBatch, or
-    (None, None) when g is not blocked. Wrappers call this ONCE per forward —
-    row/edge_mask are layer-invariant, so one kernel pass serves L layers."""
+def blocked_slot_inv_deg(g, impl: str = "einsum"):
+    """(slot ids, 1/max(in-degree,1), one-hot incidence or None) for a blocked
+    GraphBatch, or (None, None, None) when g is not blocked. Wrappers call
+    this ONCE per forward — row/edge_mask are layer-invariant, so one pass
+    serves L layers. ``impl``: 'pallas' (one-hot built in VMEM per kernel) or
+    'einsum' (one-hot materialized, ops become plain batched dots)."""
     if g.edge_block <= 0:
-        return None, None
+        return None, None, None
     slot = slot_ids(g.row, g.edge_mask, g.edge_block, g.edges_per_block)
-    deg = blocked_segment_sum(g.edge_mask[..., None], slot, g.max_nodes,
-                              g.edge_block, g.edge_tile)
-    return slot, 1.0 / jnp.maximum(deg, 1.0)
+    if impl == "einsum":
+        oh = jax.vmap(lambda s: onehot_blocks(s, g.edges_per_block, g.edge_block))(slot)
+        # in-degree is just a column sum of the incidence (masked slots carry
+        # the sentinel and are all-zero one-hot rows already)
+        deg = jnp.sum(oh, axis=-2, dtype=jnp.float32).reshape(
+            oh.shape[0], g.max_nodes, 1)
+    elif impl == "pallas":
+        oh = None
+        deg = blocked_segment_sum(g.edge_mask[..., None], slot, g.max_nodes,
+                                  g.edge_block, g.edge_tile)
+    else:
+        raise ValueError(f"unknown blocked impl {impl!r}")
+    return slot, 1.0 / jnp.maximum(deg, 1.0), oh
 
 
 class EdgeOps:
@@ -384,15 +524,19 @@ class EdgeOps:
     families share: row/col gathers and per-destination aggregations, as MXU
     one-hot kernels when the batch carries the blocked layout (with the
     reverse-edge pairing backward when available), XLA sorted-scatter
-    otherwise. ``slot``/``inv_deg`` come from :func:`blocked_slot_inv_deg`
-    (hoisted once per forward; plain arrays, so layers stay remat-able)."""
+    otherwise. ``slot``/``inv_deg``/``oh`` come from
+    :func:`blocked_slot_inv_deg` (hoisted once per forward; plain arrays, so
+    layers stay remat-able). ``oh is not None`` selects the einsum lowering,
+    otherwise the Pallas kernels."""
 
-    def __init__(self, g, slot=None, inv_deg=None):
-        self.g, self.slot, self.inv_deg = g, slot, inv_deg
+    def __init__(self, g, slot=None, inv_deg=None, oh=None):
+        self.g, self.slot, self.inv_deg, self.oh = g, slot, inv_deg, oh
         self.blocked = slot is not None
 
     def gather_rows(self, data):
         if self.blocked:
+            if self.oh is not None:
+                return jax.vmap(einsum_gather)(data, self.oh)
             return blocked_gather(data, self.slot, self.g.edge_block,
                                   self.g.edge_tile)
         return jnp.take_along_axis(data, self.g.row[..., None], axis=1)
@@ -400,6 +544,9 @@ class EdgeOps:
     def gather_cols(self, data):
         g = self.g
         if self.blocked and g.edge_pair is not None:
+            if self.oh is not None:
+                return jax.vmap(_paired_gather_ein)(data, g.col, g.edge_pair,
+                                                    self.oh)
             return paired_col_gather(data, g.col, g.edge_pair, self.slot,
                                      g.edge_block, g.edge_tile)
         return jnp.take_along_axis(data, g.col[..., None], axis=1)
@@ -410,7 +557,11 @@ class EdgeOps:
         g = self.g
         N = g.max_nodes
         if self.blocked:
-            out = blocked_segment_sum(data, self.slot, N, g.edge_block, g.edge_tile)
+            if self.oh is not None:
+                out = jax.vmap(einsum_segment_sum)(data, self.oh)
+            else:
+                out = blocked_segment_sum(data, self.slot, N, g.edge_block,
+                                          g.edge_tile)
             if mean:
                 out = out * self.inv_deg
             return out.astype(data.dtype)

@@ -27,6 +27,15 @@ import time
 
 import numpy as np
 
+# Pin the CPU backend BEFORE any backend initializes: the integrator runs in
+# jnp, and on axon-tunnel hosts the env var JAX_PLATFORMS alone does not stop
+# the tunnel backend from initializing (its get_backend hook initializes all
+# discovered platforms) — a wedged tunnel then hangs this offline generator.
+# config.update is honored; same pattern as tests/conftest.py.
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 from distegnn_tpu.data.nbody_sim import simulate_trajectories_batched  # noqa: E402

@@ -107,9 +107,11 @@ def _nbody_like_graphs(rng, n_graphs=2, n=300):
     return graphs
 
 
+@pytest.mark.parametrize("blocked_impl", ["pallas", "einsum"])
 @pytest.mark.parametrize("compute_dtype", [None, "bf16"])
-def test_fastegnn_blocked_parity(compute_dtype):
-    """Same graphs, blocked vs plain layout -> same FastEGNN output + grads."""
+def test_fastegnn_blocked_parity(compute_dtype, blocked_impl):
+    """Same graphs, blocked vs plain layout -> same FastEGNN output + grads
+    (both blocked lowerings: Pallas kernels and the einsum contraction)."""
     from distegnn_tpu.models.fast_egnn import FastEGNN
 
     rng = np.random.default_rng(5)
@@ -119,7 +121,8 @@ def test_fastegnn_blocked_parity(compute_dtype):
     assert blocked.edge_block == BLOCK
 
     model = FastEGNN(node_feat_nf=1, edge_attr_nf=2, hidden_nf=16,
-                     virtual_channels=2, n_layers=2, compute_dtype=compute_dtype)
+                     virtual_channels=2, n_layers=2, compute_dtype=compute_dtype,
+                     blocked_impl=blocked_impl)
     params = model.init(jax.random.PRNGKey(0), plain)
 
     tol = 1e-5 if compute_dtype is None else 5e-2
@@ -161,6 +164,54 @@ def test_graph_loader_blocked_layout():
         blk = np.arange(b.max_edges) // epb
         rows = np.asarray(b.row)
         assert np.all(rows // BLOCK == blk[None, :])
+
+
+def test_einsum_ops_match_plain():
+    """The einsum lowering's primitives: fwd + custom-VJP grads == plain XLA.
+    The custom VJPs exist because differentiating through the bf16 term split
+    would bf16-round the cotangent (~1e-2 error observed); with them the
+    gradients must sit at f32 noise level."""
+    from distegnn_tpu.ops.blocked import (
+        _paired_gather_ein, einsum_gather, einsum_segment_sum, onehot_blocks,
+        pairing_perm,
+    )
+
+    rng = np.random.default_rng(11)
+    g = _nbody_like_graphs(rng, n_graphs=1, n=120)[0]
+    ei = g["edge_index"]
+    n = 120
+    n_pad = -(-n // BLOCK) * BLOCK
+    epb = -(-max_block_degree(np.sort(ei[0]), n_pad, BLOCK) // 8) * 8
+    bei, _, em = blockify_edges(ei, None, n_pad, epb, BLOCK)
+    pair = pairing_perm(bei)
+    assert pair is not None
+    slot = slot_ids(jnp.asarray(bei[0]), jnp.asarray(em), BLOCK, epb)
+    oh = onehot_blocks(slot, epb, BLOCK)
+    E = bei.shape[1]
+    mask = jnp.asarray(em)[:, None]
+    x = jnp.asarray(rng.normal(size=(E, 8)).astype(np.float32)) * mask
+    h = jnp.asarray(rng.normal(size=(n_pad, 8)).astype(np.float32))
+
+    # tolerances: f32 accumulation-order noise on sums of O(100) edges/node
+    ref = segment_sum(x, jnp.asarray(bei[0]), n_pad, mask=jnp.asarray(em))
+    np.testing.assert_allclose(einsum_segment_sum(x, oh), ref, rtol=1e-5, atol=1e-4)
+    np.testing.assert_allclose(
+        einsum_gather(h, oh), np.where(em[:, None] > 0, np.asarray(h)[bei[0]], 0.0),
+        atol=2e-6)
+
+    g1 = jax.grad(lambda hh: jnp.sum(jnp.sin(einsum_gather(hh, oh)) * mask))(h)
+    g2 = jax.grad(lambda hh: jnp.sum(jnp.sin(hh[jnp.asarray(bei[0])]) * mask))(h)
+    np.testing.assert_allclose(g1, g2, atol=1e-4)  # gather grad = a seg-sum
+
+    col, pj = jnp.asarray(bei[1]), jnp.asarray(pair)
+    g3 = jax.grad(lambda hh: jnp.sum(jnp.cos(_paired_gather_ein(hh, col, pj, oh)) * mask))(h)
+    g4 = jax.grad(lambda hh: jnp.sum(jnp.cos(hh[col]) * mask))(h)
+    np.testing.assert_allclose(g3, g4, atol=1e-4)
+
+    g5 = jax.grad(lambda xx: jnp.sum(jnp.tanh(einsum_segment_sum(xx, oh))))(x)
+    g6 = jax.grad(lambda xx: jnp.sum(jnp.tanh(
+        segment_sum(xx, jnp.asarray(bei[0]), n_pad, mask=jnp.asarray(em)))))(x)
+    np.testing.assert_allclose(g5 * mask, g6 * mask, atol=1e-4)
 
 
 def test_pairing_perm():
@@ -209,8 +260,9 @@ def test_remat_same_outputs_and_grads(edge_block):
     np.testing.assert_allclose(g1, g0, atol=1e-6)
 
 
+@pytest.mark.parametrize("blocked_impl", ["pallas", "einsum"])
 @pytest.mark.parametrize("model_name", ["FastRF", "FastSchNet"])
-def test_other_fast_models_blocked_parity(model_name):
+def test_other_fast_models_blocked_parity(model_name, blocked_impl):
     """FastRF / FastSchNet: blocked layout == plain layout (fwd + grads)."""
     from jax.flatten_util import ravel_pytree
 
@@ -223,12 +275,14 @@ def test_other_fast_models_blocked_parity(model_name):
     if model_name == "FastRF":
         from distegnn_tpu.models.fast_rf import FastRF
 
-        model = FastRF(edge_attr_nf=2, hidden_nf=16, virtual_channels=2, n_layers=2)
+        model = FastRF(edge_attr_nf=2, hidden_nf=16, virtual_channels=2,
+                       n_layers=2, blocked_impl=blocked_impl)
     else:
         from distegnn_tpu.models.fast_schnet import FastSchNet
 
         model = FastSchNet(node_feat_nf=1, edge_attr_nf=2, hidden_nf=16,
-                           virtual_channels=2, n_layers=2, cutoff=2.0)
+                           virtual_channels=2, n_layers=2, cutoff=2.0,
+                           blocked_impl=blocked_impl)
     params = model.init(jax.random.PRNGKey(0), plain)
 
     xp, Xp = model.apply(params, plain)

@@ -152,8 +152,9 @@ def main():
             sys.exit("usage: bench.py [--layout plain|blocked|auto] [--impl pallas|einsum]")
         impl = args[i + 1]
 
+    edge_block = int(os.environ.get("BENCH_EDGE_BLOCK", 256))
     if layout in ("plain", "blocked"):
-        print(json.dumps(measure(256 if layout == "blocked" else 0, impl)))
+        print(json.dumps(measure(edge_block if layout == "blocked" else 0, impl)))
         return
 
     # auto: try the blocked layout in a CHILD so a compiler surprise on new

@@ -73,6 +73,10 @@ _DEFAULTS: dict = {
         # gathers become batched MXU dots — default) or 'pallas' (one-hot
         # built in VMEM per kernel) — see ops/blocked.py
         "blocked_impl": "einsum",
+        # FastEGNN: evaluate phi_e's first Dense on the node axis (same math,
+        # E/N x fewer matmul rows); False restores the reference-shaped
+        # concat MLP (different param tree)
+        "hoist_edge_mlp": True,
     },
     "data": {
         "data_dir": "./data",

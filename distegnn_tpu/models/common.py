@@ -106,6 +106,46 @@ class CoordMLP(nn.Module):
         return x
 
 
+class HoistedEdgeMLP(nn.Module):
+    """phi_e with its first Dense algebraically hoisted to the node axis.
+
+    The edge-message MLP's first layer is linear, and gathering commutes with
+    a linear map, so
+
+        concat([h_row, h_col, s]) @ W
+            == gather_row(h @ W[:H]) + gather_col(h @ W[H:2H]) + s @ W[2H:]
+
+    which (a) never materializes the [E, 2H+S] concat, (b) runs the big
+    matmul over N rows instead of E (E/N = mean degree, ~15 at LargeFluid
+    scale), and (c) gathers compute-dtype (bf16) products instead of f32
+    features — all exactly the same math as MLP([H, H], act_last=True) on
+    the concat, in a cheaper order (BASELINE.md round-2 optimization list).
+    Parameters: one fused (2H+S, H) kernel + bias with torch nn.Linear
+    defaults at the FULL fan-in, so init parity matches the fused Dense.
+
+    ``ops`` is the EdgeOps dispatch — the gathers ride the blocked one-hot
+    fast path when the batch carries it.
+    """
+
+    hidden_nf: int
+    scalar_nf: int           # per-edge scalar features: radial (+ edge_attr)
+    act: Callable = nn.silu
+    dtype: Optional[Any] = None
+
+    @nn.compact
+    def __call__(self, h, scalars, ops):
+        H = self.hidden_nf
+        fan_in = 2 * H + self.scalar_nf
+        w = self.param("kernel", torch_linear_init, (fan_in, H), jnp.float32)
+        b = self.param("bias", _torch_bias_init(fan_in), (H,), jnp.float32)
+        if self.dtype is not None:
+            h, scalars, w, b = (a.astype(self.dtype) for a in (h, scalars, w, b))
+        y = (ops.gather_rows(h @ w[:H]) + ops.gather_cols(h @ w[H:2 * H])
+             + scalars @ w[2 * H:] + b)
+        y = self.act(y)
+        return self.act(TorchDense(H, dtype=self.dtype)(y))
+
+
 def resolve_dtype(d):
     """Normalize a compute-dtype spec (None | 'bf16' | 'bfloat16' | dtype) to
     a jnp dtype or None (= float32 compute)."""

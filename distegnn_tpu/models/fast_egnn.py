@@ -23,7 +23,9 @@ import jax
 import jax.numpy as jnp
 from flax import linen as nn
 
-from distegnn_tpu.models.common import MLP, CoordMLP, TorchDense, resolve_dtype
+from distegnn_tpu.models.common import (
+    MLP, CoordMLP, HoistedEdgeMLP, TorchDense, resolve_dtype,
+)
 from distegnn_tpu.ops.blocked import EdgeOps, blocked_slot_inv_deg
 from distegnn_tpu.ops.graph import GraphBatch
 from distegnn_tpu.parallel.collectives import global_node_mean
@@ -54,6 +56,10 @@ class EGCLVel(nn.Module):
     # f32, so equivariance is exact at math level — bf16 only widens noise in
     # invariant channels. See tests/test_equivariance.py::test_bf16.
     compute_dtype: Optional[str] = None
+    # evaluate phi_e's first Dense on the node axis (HoistedEdgeMLP): same
+    # math, E/N x fewer matmul rows, no [E, 2H+S] concat. False restores the
+    # reference-shaped concat MLP (different param tree — not ckpt-compatible)
+    hoist_edge_mlp: bool = True
 
     @nn.compact
     def __call__(
@@ -88,10 +94,17 @@ class EGCLVel(nn.Module):
         virtual_radial = jnp.linalg.norm(vcd, axis=2, keepdims=True)    # [B, N, 1, C]
 
         # --- real edge messages phi_e (:144-150)
-        e_in = [ops.gather_rows(h), ops.gather_cols(h), radial]
-        if self.edge_attr_nf:
-            e_in.append(g.edge_attr)
-        edge_feat = MLP([H, H], act_last=True, name="phi_e", dtype=dt)(jnp.concatenate(e_in, axis=-1))
+        if self.hoist_edge_mlp:
+            scalars = (jnp.concatenate([radial, g.edge_attr], axis=-1)
+                       if self.edge_attr_nf else radial)
+            edge_feat = HoistedEdgeMLP(H, 1 + self.edge_attr_nf,
+                                       name="phi_e", dtype=dt)(h, scalars, ops)
+        else:
+            e_in = [ops.gather_rows(h), ops.gather_cols(h), radial]
+            if self.edge_attr_nf:
+                e_in.append(g.edge_attr)
+            edge_feat = MLP([H, H], act_last=True, name="phi_e", dtype=dt)(
+                jnp.concatenate(e_in, axis=-1))
         if self.attention:
             gate_e = jax.nn.sigmoid(TorchDense(1, name="att", dtype=dt)(edge_feat))
             edge_feat = edge_feat * gate_e                               # [B, E, H]
@@ -183,6 +196,7 @@ class FastEGNN(nn.Module):
     gravity: Optional[Tuple[float, float, float]] = None
     axis_name: Optional[str] = None
     compute_dtype: Optional[str] = None  # 'bf16' -> MXU-native message MLPs
+    hoist_edge_mlp: bool = True  # phi_e first Dense on the node axis (see EGCLVel)
     # lowering of the blocked-layout edge ops (used only when the batch
     # carries edge_block > 0): 'einsum' = one-hot materialized once per
     # forward, ops are batched dots (default — no Pallas grid overhead);
@@ -228,6 +242,7 @@ class FastEGNN(nn.Module):
                 has_gravity=self.gravity is not None,
                 axis_name=self.axis_name,
                 compute_dtype=self.compute_dtype,
+                hoist_edge_mlp=self.hoist_edge_mlp,
                 name=f"gcl_{i}",
             )(h, x, v, X, Hv, g, gravity=gravity, slot=slot, inv_deg=inv_deg,
               oh=oh)

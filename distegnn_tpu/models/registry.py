@@ -49,6 +49,7 @@ def get_model(model_config, world_size: int = 1, dataset_name: Optional[str] = N
             compute_dtype=model_config.get("compute_dtype"),
             remat=bool(model_config.get("remat", False)),
             blocked_impl=model_config.get("blocked_impl", "einsum"),
+            hoist_edge_mlp=bool(model_config.get("hoist_edge_mlp", True)),
         )
     if name == "FastRF":
         FastRF = _import_model("fast_rf", "FastRF")

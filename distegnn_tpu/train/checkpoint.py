@@ -28,7 +28,21 @@ def _to_leaves(tree) -> list:
 
 def _from_leaves(template, leaves: list):
     treedef = jax.tree.structure(template)
-    return jax.tree.unflatten(treedef, [np.asarray(l) for l in leaves])
+    tmpl_leaves = jax.tree.leaves(template)
+    leaves = [np.asarray(l) for l in leaves]
+    if len(leaves) != len(tmpl_leaves):
+        raise ValueError(
+            f"checkpoint incompatible with model: {len(leaves)} saved arrays vs "
+            f"{len(tmpl_leaves)} expected — was the checkpoint written by a "
+            "different architecture/config (e.g. hoist_edge_mlp flipped)?")
+    for i, (saved, want) in enumerate(zip(leaves, tmpl_leaves)):
+        if tuple(saved.shape) != tuple(np.shape(want)):
+            raise ValueError(
+                f"checkpoint incompatible with model: array {i} has shape "
+                f"{tuple(saved.shape)}, model expects {tuple(np.shape(want))} — "
+                "was the checkpoint written by a different architecture/config "
+                "(e.g. hoist_edge_mlp flipped)?")
+    return jax.tree.unflatten(treedef, leaves)
 
 
 def save_checkpoint(path: str, state, epoch: int, losses: Optional[dict] = None,

@@ -200,3 +200,30 @@ def test_checkpoint_roundtrip(tmp_path, tiny_setup):
     assert epoch == 7 and losses["loss_valid"] == 0.5
     for a, b in zip(jax.tree.leaves(state.params), jax.tree.leaves(restored.params)):
         np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_checkpoint_rejects_mismatched_architecture(tmp_path, tiny_setup):
+    """Restoring into a different param tree (e.g. hoist_edge_mlp flipped)
+    must fail loudly, not zip mismatched leaves into garbage params."""
+    import pytest
+
+    from distegnn_tpu.models.fast_egnn import FastEGNN
+    from distegnn_tpu.ops.graph import pad_graphs
+
+    model, params, graphs = tiny_setup
+    batch = pad_graphs(graphs[:4])
+    tx = make_optimizer(1e-3, weight_decay=1e-8)
+    state = TrainState.create(params, tx)
+    path = str(tmp_path / "ckpt" / "best_model.ckpt")
+    save_checkpoint(path, state, epoch=1)
+
+    other = FastEGNN(node_feat_nf=model.node_feat_nf,
+                     edge_attr_nf=model.edge_attr_nf,
+                     hidden_nf=model.hidden_nf,
+                     virtual_channels=model.virtual_channels,
+                     n_layers=model.n_layers,
+                     hoist_edge_mlp=not model.hoist_edge_mlp)
+    p2 = other.init(jax.random.PRNGKey(0), batch)
+    fresh = TrainState.create(p2, tx)
+    with pytest.raises(ValueError, match="checkpoint incompatible"):
+        restore_checkpoint(path, fresh)

@@ -75,6 +75,12 @@ def make_fluid_batch(rng, edge_block: int = 0):
     return pad_graphs([graph], **kw), n_edges
 
 
+def layout_tag(edge_block: int, impl: str) -> str:
+    """The machine-read layout label shared by bench.py and profile_step.py
+    outputs (pasted into BASELINE.md tables)."""
+    return f"blocked{edge_block}-{impl}" if edge_block else "plain"
+
+
 def measure(edge_block: int, impl: str = "einsum"):
     import jax
 
@@ -117,7 +123,7 @@ def measure(edge_block: int, impl: str = "einsum"):
 
     nodes_per_sec = N_NODES * STEPS / dt
     platform = jax.devices()[0].platform
-    layout = f"blocked{edge_block}-{impl}" if edge_block else "plain"
+    layout = layout_tag(edge_block, impl)
     official = N_NODES == 113_140  # vs_baseline is meaningless off-workload
     return {
         "metric": "largefluid_train_nodes_per_sec_per_chip",

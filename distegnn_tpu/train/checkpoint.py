@@ -71,9 +71,16 @@ def restore_checkpoint(path: str, state) -> tuple[Any, int, dict]:
         payload = pickle.load(f)
     from distegnn_tpu.train.step import TrainState
 
-    restored = TrainState(
-        params=_from_leaves(state.params, payload["params_leaves"]),
-        opt_state=_from_leaves(state.opt_state, payload["opt_state_leaves"]),
-        step=np.int32(payload["step"]),
-    )
+    try:
+        restored = TrainState(
+            params=_from_leaves(state.params, payload["params_leaves"]),
+            opt_state=_from_leaves(state.opt_state, payload["opt_state_leaves"]),
+            step=np.int32(payload["step"]),
+        )
+    except ValueError as e:
+        saved_cfg = payload.get("config") or {}
+        model_cfg = saved_cfg.get("model") if isinstance(saved_cfg, dict) else None
+        hint = (f"; the checkpoint was written with model config {model_cfg}"
+                if model_cfg else "")
+        raise ValueError(f"{e}{hint}") from None
     return restored, payload["epoch"], payload.get("losses", {})

@@ -1,0 +1,54 @@
+#!/usr/bin/env bash
+# One-shot hardware measurement pass for a flaky TPU tunnel window.
+#
+# The axon tunnel wedges unpredictably (BASELINE.md), so when a window opens
+# every pending measurement should run unattended, serially, with the host
+# otherwise idle. This script:
+#   1. probes the TPU (60 s timeout) and exits 2 if wedged;
+#   2. SIGSTOPs any running n-body generator (host contention degrades step
+#      timing ~4x — BASELINE.md measurement discipline), resuming it on exit;
+#   3. runs the measurement queue, appending JSON/readable output to $LOG.
+#
+# Usage: bash scripts/hw_session.sh [logfile]   (default /tmp/hw_session.log)
+
+set -u
+cd "$(dirname "$0")/.."
+LOG=${1:-/tmp/hw_session.log}
+
+probe() {
+  timeout 60 python -c "
+import jax, jax.numpy as jnp
+print('probe ok', float((jnp.ones((256,256))@jnp.ones((256,256))).sum()))" \
+    >>"$LOG" 2>&1
+}
+
+echo "=== hw_session $(date -u +%FT%TZ) ===" >>"$LOG"
+if ! probe; then
+  echo "TPU wedged; aborting" >>"$LOG"
+  exit 2
+fi
+
+GEN_PIDS=$(pgrep -f "generate_nbody_chunked" || true)
+resume() { [ -n "$GEN_PIDS" ] && kill -CONT $GEN_PIDS 2>/dev/null; }
+trap resume EXIT
+[ -n "$GEN_PIDS" ] && kill -STOP $GEN_PIDS 2>/dev/null
+
+run() {  # run <label> <timeout_s> <cmd...>
+  local label=$1 to=$2; shift 2
+  echo "--- $label ($(date -u +%T)) ---" >>"$LOG"
+  timeout "$to" "$@" >>"$LOG" 2>&1
+  echo "--- $label rc=$? ---" >>"$LOG"
+}
+
+# 1. isolate the primitives: Pallas tile sweep + einsum variants
+run microbench 2400 python scripts/microbench_blocked.py
+# 2. headline bench: einsum blocked (256 and 128), plain control
+run bench_einsum_256 1200 python bench.py --layout blocked --impl einsum
+run bench_einsum_128 1200 env BENCH_EDGE_BLOCK=128 \
+  python bench.py --layout blocked --impl einsum
+run bench_plain 1200 python bench.py --layout plain
+# 3. step breakdown on the best-known layout
+run profile_einsum 1200 python scripts/profile_step.py --bf16 --edge-block 256
+run profile_plain 1200 python scripts/profile_step.py --bf16
+
+echo "=== hw_session done $(date -u +%FT%TZ) ===" >>"$LOG"

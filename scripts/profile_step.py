@@ -62,6 +62,10 @@ def main():
     ap.add_argument("--steps", type=int, default=10)
     ap.add_argument("--nodes", type=int, default=113_140)
     ap.add_argument("--bf16", action="store_true", help="compute_dtype='bf16'")
+    ap.add_argument("--edge-block", type=int, default=0,
+                    help="blocked edge layout (0 = plain)")
+    ap.add_argument("--impl", default="einsum", choices=["einsum", "pallas"],
+                    help="blocked-op lowering (with --edge-block)")
     args = ap.parse_args()
 
     import jax
@@ -75,13 +79,14 @@ def main():
     from distegnn_tpu.train.loss import masked_mse, mmd_loss
 
     rng = np.random.default_rng(0)
-    batch, n_edges = make_fluid_batch(rng)
+    batch, n_edges = make_fluid_batch(rng, edge_block=args.edge_block)
     dev = jax.devices()[0]
     batch = jax.device_put(batch, dev)
 
     model = FastEGNN(node_feat_nf=3, node_attr_nf=2, edge_attr_nf=2,
                      hidden_nf=HIDDEN, virtual_channels=CHANNELS, n_layers=LAYERS,
-                     compute_dtype="bf16" if args.bf16 else None)
+                     compute_dtype="bf16" if args.bf16 else None,
+                     blocked_impl=args.impl)
     params = model.init(jax.random.PRNGKey(0), batch)
     tx = make_optimizer(5e-4, weight_decay=1e-12, clip_norm=0.3)
     state = TrainState.create(params, tx)
@@ -105,8 +110,11 @@ def main():
     import jax.numpy as jnp
     vloc = jnp.zeros((1, 3, CHANNELS))
 
+    from bench import layout_tag
+
     res = {"n_nodes": args.nodes, "n_edges": int(n_edges),
-           "platform": dev.platform, "device": str(dev.device_kind)}
+           "platform": dev.platform, "device": str(dev.device_kind),
+           "layout": layout_tag(args.edge_block, args.impl)}
     res["t_forward_ms"] = timed(fwd, params, batch, steps=args.steps) * 1e3
     res["t_grad_ms"] = timed(grad_fn, params, batch, key, steps=args.steps) * 1e3
     res["t_step_full_ms"] = timed(step_mmd, state, batch, key, steps=args.steps) * 1e3

@@ -73,9 +73,11 @@ _DEFAULTS: dict = {
         # gathers become batched MXU dots — default) or 'pallas' (one-hot
         # built in VMEM per kernel) — see ops/blocked.py
         "blocked_impl": "einsum",
-        # FastEGNN: evaluate phi_e's first Dense on the node axis (same math,
-        # E/N x fewer matmul rows); False restores the reference-shaped
-        # concat MLP (different param tree)
+        # FastEGNN + FastSchNet: evaluate the edge MLPs' first Dense on the
+        # node axis (FastEGNN's phi_e; FastSchNet's phi_e AND its SchNet
+        # coordinate gate) — same math, E/N x fewer matmul rows. Flipping it
+        # changes those models' param trees (checkpoints are incompatible
+        # across the flag; restore fails with a clear error)
         "hoist_edge_mlp": True,
     },
     "data": {

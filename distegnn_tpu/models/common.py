@@ -106,6 +106,21 @@ class CoordMLP(nn.Module):
         return x
 
 
+def _hoisted_linear(w, b, h, scalars, ops, hidden, scalars_first, dtype):
+    """The shared hoisted-linear core: a fused concat-Dense over
+    (h_row, h_col, scalars) — in either concat order — evaluated with the
+    matmul on the node axis (gathering commutes with linear maps)."""
+    if dtype is not None:
+        h, scalars, w, b = (a.astype(dtype) for a in (h, scalars, w, b))
+    H = hidden
+    S = w.shape[0] - 2 * H
+    if scalars_first:
+        ws, wr, wc = w[:S], w[S:S + H], w[S + H:]
+    else:
+        wr, wc, ws = w[:H], w[H:2 * H], w[2 * H:]
+    return ops.gather_rows(h @ wr) + ops.gather_cols(h @ wc) + scalars @ ws + b
+
+
 class HoistedEdgeMLP(nn.Module):
     """phi_e with its first Dense algebraically hoisted to the node axis.
 
@@ -138,12 +153,30 @@ class HoistedEdgeMLP(nn.Module):
         fan_in = 2 * H + self.scalar_nf
         w = self.param("kernel", torch_linear_init, (fan_in, H), jnp.float32)
         b = self.param("bias", _torch_bias_init(fan_in), (H,), jnp.float32)
-        if self.dtype is not None:
-            h, scalars, w, b = (a.astype(self.dtype) for a in (h, scalars, w, b))
-        y = (ops.gather_rows(h @ w[:H]) + ops.gather_cols(h @ w[H:2 * H])
-             + scalars @ w[2 * H:] + b)
-        y = self.act(y)
+        y = self.act(_hoisted_linear(w, b, h, scalars, ops, H,
+                                     scalars_first=False, dtype=self.dtype))
         return self.act(TorchDense(H, dtype=self.dtype)(y))
+
+
+class HoistedGate(nn.Module):
+    """Single Dense over concat([scalars, h_row, h_col]) hoisted to the node
+    axis (same algebra as :class:`HoistedEdgeMLP`, scalars-first concat order,
+    no activation) — FastSchNet's coordinate gate. Init parity: fused kernel
+    + bias with torch nn.Linear defaults at the full fan-in."""
+
+    features: int
+    scalar_nf: int
+    hidden_nf: int
+    dtype: Optional[Any] = None
+
+    @nn.compact
+    def __call__(self, h, scalars, ops):
+        S, H = self.scalar_nf, self.hidden_nf
+        fan_in = S + 2 * H
+        w = self.param("kernel", torch_linear_init, (fan_in, self.features), jnp.float32)
+        b = self.param("bias", _torch_bias_init(fan_in), (self.features,), jnp.float32)
+        return _hoisted_linear(w, b, h, scalars, ops, H,
+                               scalars_first=True, dtype=self.dtype)
 
 
 def resolve_dtype(d):

@@ -26,7 +26,9 @@ import jax
 import jax.numpy as jnp
 from flax import linen as nn
 
-from distegnn_tpu.models.common import MLP, CoordMLP, TorchDense
+from distegnn_tpu.models.common import (
+    MLP, CoordMLP, HoistedEdgeMLP, HoistedGate, TorchDense,
+)
 from distegnn_tpu.ops.blocked import EdgeOps, blocked_slot_inv_deg
 from distegnn_tpu.models.schnet import GaussianSmearing
 from distegnn_tpu.ops.graph import GraphBatch
@@ -49,6 +51,7 @@ class SchNetGCLVel(nn.Module):
     has_gravity: bool = False
     axis_name: Optional[str] = None
     epsilon: float = 1e-8
+    hoist_edge_mlp: bool = True  # phi_e + gate first Dense on the node axis
 
     @nn.compact
     def __call__(self, h, x, v, X, Hv, g: GraphBatch, gravity=None,
@@ -63,17 +66,23 @@ class SchNetGCLVel(nn.Module):
         # REFERENCE: its coord2radial normalizes coord_diff, which FastSchNet
         # then never consumes (only radial and the SchNet sublayer's raw
         # positions are used, FastSchNet.py:169-186)
-        h_row, h_col = ops.gather_rows(h), ops.gather_cols(h)
         raw_diff = ops.gather_rows(x) - ops.gather_cols(x)
         radial = jnp.sum(raw_diff**2, axis=-1, keepdims=True)
         vcd = X[:, None, :, :] - x[..., None]                            # [B, N, 3, C]
         virtual_radial = jnp.linalg.norm(vcd, axis=2, keepdims=True)
 
-        # real edge messages phi_e (FastSchNet.py:102-108)
-        e_in = [h_row, h_col, radial]
-        if self.edge_attr_nf:
-            e_in.append(g.edge_attr)
-        edge_feat = MLP([H, H], act_last=True, name="phi_e")(jnp.concatenate(e_in, axis=-1))
+        # real edge messages phi_e (FastSchNet.py:102-108); hoisted mode never
+        # gathers raw h at all — phi_e AND the SchNet gate below move node-side
+        # matmul products instead
+        e_scalars = (jnp.concatenate([radial, g.edge_attr], axis=-1)
+                     if self.edge_attr_nf else radial)
+        if self.hoist_edge_mlp:
+            edge_feat = HoistedEdgeMLP(H, 1 + self.edge_attr_nf,
+                                       name="phi_e")(h, e_scalars, ops)
+        else:
+            h_row, h_col = ops.gather_rows(h), ops.gather_cols(h)
+            edge_feat = MLP([H, H], act_last=True, name="phi_e")(
+                jnp.concatenate([h_row, h_col, e_scalars], axis=-1))
         if self.attention:
             edge_feat = edge_feat * jax.nn.sigmoid(TorchDense(1, name="att")(edge_feat))
         edge_feat = edge_feat * edge_mask[..., None]
@@ -103,8 +112,12 @@ class SchNetGCLVel(nn.Module):
         # SchNet sublayer always works on bare positions
         edge_weight = jnp.linalg.norm(raw_diff + 1e-30, axis=-1)
         gauss = GaussianSmearing(0.0, self.cutoff, self.num_gaussians, name="smearing")(edge_weight)
-        gate = TorchDense(1, name="schnet_coord_update")(
-            jnp.concatenate([gauss, h_row, h_col], axis=-1))
+        if self.hoist_edge_mlp:
+            gate = HoistedGate(1, self.num_gaussians, H,
+                               name="schnet_coord_update")(h, gauss, ops)
+        else:
+            gate = TorchDense(1, name="schnet_coord_update")(
+                jnp.concatenate([gauss, h_row, h_col], axis=-1))
         x = x + ops.agg_rows_mean(raw_diff * gate)
 
         # virtual pull on real nodes (phi_xv / coord_mlp_r_virtual)
@@ -152,6 +165,7 @@ class FastSchNet(nn.Module):
     gravity: Optional[Tuple[float, float, float]] = None
     axis_name: Optional[str] = None
     blocked_impl: str = "einsum"  # blocked-layout edge-op lowering ('pallas'|'einsum')
+    hoist_edge_mlp: bool = True   # phi_e + gate first Dense on the node axis
 
     @nn.compact
     def __call__(self, g: GraphBatch) -> Tuple[jnp.ndarray, jnp.ndarray]:
@@ -176,7 +190,8 @@ class FastSchNet(nn.Module):
                 cutoff=self.cutoff, residual=self.residual,
                 attention=self.attention, normalize=self.normalize,
                 tanh=self.tanh, has_gravity=self.gravity is not None,
-                axis_name=self.axis_name, name=f"gcl_{i}",
+                axis_name=self.axis_name, hoist_edge_mlp=self.hoist_edge_mlp,
+                name=f"gcl_{i}",
             )(h, x, v, X, Hv, g, gravity=gravity, slot=slot, inv_deg=inv_deg,
               oh=oh)
         return x, X

@@ -78,6 +78,7 @@ def get_model(model_config, world_size: int = 1, dataset_name: Optional[str] = N
                 cutoff=cutoff,
                 axis_name=axis_name,
                 blocked_impl=model_config.get("blocked_impl", "einsum"),
+                hoist_edge_mlp=bool(model_config.get("hoist_edge_mlp", True)),
             )
         SchNet = _import_model("schnet", "SchNet")
         return SchNet(hidden_channels=model_config.hidden_nf, cutoff=cutoff)

@@ -73,37 +73,26 @@ def test_model_jits_and_is_finite(rng, name):
     assert np.all(np.isfinite(np.asarray(out)))
 
 
-def test_hoisted_edge_mlp_equals_concat_mlp(rng):
-    """FastEGNN hoist_edge_mlp=True computes the SAME function as the
-    reference-shaped concat MLP: remap the fused phi_e params between the two
-    trees and compare outputs + gradients."""
+def _remap_fused_mlp(node):
+    """concat tree phi_e/TorchDense_0/Dense_0 (fused first Dense) +
+    TorchDense_1 -> hoisted tree phi_e/{kernel,bias} + TorchDense_0."""
+    return {
+        "kernel": node["TorchDense_0"]["Dense_0"]["kernel"],
+        "bias": node["TorchDense_0"]["Dense_0"]["bias"],
+        "TorchDense_0": node["TorchDense_1"],
+    }
+
+
+def _assert_hoisted_equals_concat(m_h, m_c, gb, remap):
+    """Shared hoisting-equivalence check: remap the fused params of the
+    concat model into the hoisted tree, then compare outputs and per-leaf
+    gradients (leaf-by-leaf through the SAME remap — catches misrouted
+    cotangents that a scalar-sum comparison would let cancel)."""
     import jax.numpy as jnp
     from jax.flatten_util import ravel_pytree
 
-    from distegnn_tpu.models.fast_egnn import FastEGNN
-
-    g = _random_graph(rng, n=40, e=120, feat_nf=1, edge_nf=2)
-    gb = pad_graphs([g], node_bucket=1, edge_bucket=1)
-    kw = dict(node_feat_nf=1, edge_attr_nf=2, hidden_nf=16,
-              virtual_channels=2, n_layers=2)
-    m_h = FastEGNN(**kw, hoist_edge_mlp=True)
-    m_c = FastEGNN(**kw, hoist_edge_mlp=False)
     p_c = jax.device_get(m_c.init(jax.random.PRNGKey(0), gb))
-
-    # concat tree: phi_e/TorchDense_0/Dense_0/{kernel,bias} (fused first Dense)
-    # hoisted tree: phi_e/{kernel,bias} + phi_e/TorchDense_0 (second Dense)
-    def remap_to_hoisted(concat_params):
-        out = jax.device_get(concat_params)  # plain nested dicts, host copies
-        for i in range(kw["n_layers"]):
-            src = out["params"][f"gcl_{i}"]["phi_e"]
-            out["params"][f"gcl_{i}"]["phi_e"] = {
-                "kernel": src["TorchDense_0"]["Dense_0"]["kernel"],
-                "bias": src["TorchDense_0"]["Dense_0"]["bias"],
-                "TorchDense_0": src["TorchDense_1"],
-            }
-        return out
-
-    p_h = remap_to_hoisted(p_c)
+    p_h = remap(p_c)
     x_c, X_c = m_c.apply(p_c, gb)
     x_h, X_h = m_h.apply(p_h, gb)
     np.testing.assert_allclose(x_h, x_c, atol=1e-5)
@@ -113,14 +102,57 @@ def test_hoisted_edge_mlp_equals_concat_mlp(rng):
         x, _ = m.apply(p, gb)
         return jnp.sum((x - gb.target) ** 2 * gb.node_mask[..., None])
 
-    g_c = jax.grad(lambda p: loss(m_c, p))(p_c)
-    g_h = jax.grad(lambda p: loss(m_h, p))(p_h)
-    # leaf-by-leaf through the SAME remap — catches misrouted cotangents that
-    # a scalar-sum comparison would let cancel
-    flat_c = ravel_pytree(remap_to_hoisted(g_c))[0]
-    flat_h = ravel_pytree(g_h)[0]
+    flat_c = ravel_pytree(remap(jax.grad(lambda p: loss(m_c, p))(p_c)))[0]
+    flat_h = ravel_pytree(jax.grad(lambda p: loss(m_h, p))(p_h))[0]
     scale = np.maximum(np.abs(flat_c).max(), 1.0)
     np.testing.assert_allclose(flat_h / scale, flat_c / scale, atol=1e-5)
+
+
+def test_hoisted_edge_mlp_equals_concat_mlp(rng):
+    """FastEGNN hoist_edge_mlp=True computes the SAME function as the
+    reference-shaped concat MLP."""
+    from distegnn_tpu.models.fast_egnn import FastEGNN
+
+    g = _random_graph(rng, n=40, e=120, feat_nf=1, edge_nf=2)
+    gb = pad_graphs([g], node_bucket=1, edge_bucket=1)
+    kw = dict(node_feat_nf=1, edge_attr_nf=2, hidden_nf=16,
+              virtual_channels=2, n_layers=2)
+
+    def remap(tree):
+        out = jax.device_get(tree)
+        for i in range(kw["n_layers"]):
+            gcl = out["params"][f"gcl_{i}"]
+            gcl["phi_e"] = _remap_fused_mlp(gcl["phi_e"])
+        return out
+
+    _assert_hoisted_equals_concat(FastEGNN(**kw, hoist_edge_mlp=True),
+                                  FastEGNN(**kw, hoist_edge_mlp=False),
+                                  gb, remap)
+
+
+def test_fastschnet_hoisted_equals_concat(rng):
+    """FastSchNet hoisting covers BOTH phi_e and the SchNet coordinate gate
+    (concat orders differ: MLP is [h_row, h_col, scalars], gate is
+    [gauss, h_row, h_col] — the hoisted modules slice to match, so the raw
+    kernels map 1:1)."""
+    g = _random_graph(rng, n=40, e=120, feat_nf=1, edge_nf=2)
+    gb = pad_graphs([g], node_bucket=1, edge_bucket=1)
+    kw = dict(node_feat_nf=1, edge_attr_nf=2, hidden_nf=16,
+              virtual_channels=2, n_layers=2, cutoff=2.0)
+
+    def remap(tree):
+        out = jax.device_get(tree)
+        for i in range(kw["n_layers"]):
+            gcl = out["params"][f"gcl_{i}"]
+            gcl["phi_e"] = _remap_fused_mlp(gcl["phi_e"])
+            gate = gcl["schnet_coord_update"]["Dense_0"]
+            gcl["schnet_coord_update"] = {"kernel": gate["kernel"],
+                                          "bias": gate["bias"]}
+        return out
+
+    _assert_hoisted_equals_concat(FastSchNet(**kw, hoist_edge_mlp=True),
+                                  FastSchNet(**kw, hoist_edge_mlp=False),
+                                  gb, remap)
 
 
 def test_fast_models_padding_invariance(rng):

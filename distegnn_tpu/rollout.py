@@ -58,7 +58,7 @@ def make_rollout_fn(
     if (max_degree * edge_block) % 512:
         raise ValueError("max_degree * edge_block must be a multiple of 512")
 
-    def one_step(params, x, v, node_mask):
+    def one_step(params, x, v, node_mask, feat_args):
         g = radius_graph_dev(x, radius, max_degree, max_per_cell,
                              node_mask=node_mask)
         ei, em = ell_to_edge_list(g)
@@ -69,7 +69,7 @@ def make_rollout_fn(
         attr = (node_attr if node_attr is not None
                 else jnp.zeros((N, 0), jnp.float32))
         batch = GraphBatch(
-            node_feat=(feature_fn(v) * nm)[None],
+            node_feat=(feature_fn(v, *feat_args) * nm)[None],
             node_attr=(attr * nm)[None],
             loc=(x * nm)[None],
             vel=(v * nm)[None],
@@ -88,15 +88,19 @@ def make_rollout_fn(
         overflow = g.cell_overflow | g.degree_overflow
         return x_next, overflow
 
-    def rollout(params, loc0, vel0, node_mask, steps: int
+    def rollout(params, loc0, vel0, node_mask, steps: int, feat_args=()
                 ) -> Tuple[jnp.ndarray, jnp.ndarray]:
+        """``feat_args``: extra traced arrays forwarded to ``feature_fn(v,
+        *feat_args)`` — per-rollout constants (e.g. charges) passed as
+        arguments instead of closures, so one jitted rollout serves many
+        samples (jit with ``static_argnums=(4,)``)."""
         if loc0.shape[0] % edge_block:
             raise ValueError(f"N={loc0.shape[0]} must be a multiple of "
                              f"edge_block={edge_block} (pad loc0/node_mask)")
 
         def body(carry, _):
             x, v = carry
-            x_next, overflow = one_step(params, x, v, node_mask)
+            x_next, overflow = one_step(params, x, v, node_mask, feat_args)
             v_next = (x_next - x) if velocity_from_delta else v
             return (x_next, v_next), (x_next, overflow)
 

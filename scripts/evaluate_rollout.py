@@ -1,0 +1,174 @@
+"""Autoregressive rollout MSE evaluation (the BASELINE.md "rollout MSE"
+surface).
+
+The reference evaluates one-step MSE only; this drives the framework's
+on-device rollout (distegnn_tpu/rollout.py: predict -> rebuild the radius
+graph on device -> next step, all inside one lax.scan) against ground-truth
+trajectory frames and reports MSE per horizon.
+
+Currently wired for the n-body datasets (raw loc/vel/charges .npy
+trajectories; full graph emulated with a radius larger than the system).
+Fluid/Water trajectories work through the same make_rollout_fn — add their
+raw-trajectory loaders here when evaluating those.
+
+Usage:
+  python scripts/evaluate_rollout.py --config_path configs/nbody_fastegnn.yaml \
+      [--checkpoint logs/.../best_model.ckpt] [--samples 50] [--split test]
+
+Prints one JSON line: {"metric": "rollout_mse", "horizons": {frame: mse}, ...}
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def evaluate_nbody_rollout(config, checkpoint=None, samples=50, split="test",
+                           edge_block=256, seed=0):
+    """Rollout the n-body test trajectories; returns {horizon_frame: mse}."""
+    import jax
+    import jax.numpy as jnp
+
+    from distegnn_tpu.data.nbody import _find_tag
+    from distegnn_tpu.models.registry import get_model
+    from distegnn_tpu.ops.graph import _round_up
+    from distegnn_tpu.rollout import make_rollout_fn
+
+    base = os.path.join(config.data.data_dir, config.data.dataset_name)
+    tag = _find_tag(base, split)
+    loc = np.load(os.path.join(base, f"loc_{split}_{tag}.npy"))[:samples]
+    vel = np.load(os.path.join(base, f"vel_{split}_{tag}.npy"))[:samples]
+    charges = np.load(os.path.join(base, f"charges_{split}_{tag}.npy"))[:samples]
+    num, T, n, _ = loc.shape
+    f0, fT = config.data.frame_0, config.data.frame_T
+    delta = fT - f0
+    steps = max((T - 1 - f0) // delta, 1)
+    horizons = [f0 + (k + 1) * delta for k in range(steps) if f0 + (k + 1) * delta < T]
+    if not horizons:
+        raise ValueError(
+            f"trajectory too short to evaluate: T={T} frames, first horizon "
+            f"would be frame {f0 + delta} (frame_0={f0}, delta={delta})")
+
+    N = _round_up(n, edge_block)
+    node_mask = np.zeros((N,), np.float32)
+    node_mask[:n] = 1.0
+
+    # full graph (radius -1) emulated with a radius larger than any system
+    # extent; real radius configs pass through unchanged
+    radius = float(config.data.radius)
+    if radius <= 0:
+        radius = float(np.abs(loc).max()) * 2.0 + 1.0
+    max_degree = _round_up(min(n, 256) - 1, 2)
+    while (max_degree * edge_block) % 512:
+        max_degree += 2
+
+    model = get_model(config.model, dataset_name=config.data.dataset_name)
+
+    def feature_fn(v, qn):
+        speed = jnp.linalg.norm(v, axis=-1, keepdims=True)
+        return jnp.concatenate([speed, qn], axis=-1)
+
+    rollout = jax.jit(
+        make_rollout_fn(model, radius=radius, max_degree=max_degree,
+                        max_per_cell=N, feature_fn=feature_fn,
+                        edge_block=edge_block),
+        static_argnums=(4,))
+
+    mask_j = jnp.asarray(node_mask)
+    mse_acc = {h: 0.0 for h in horizons}
+    params = None
+    for k in range(num):
+        # charges passed per-sample as a rollout ARGUMENT (not a closure), so
+        # the jitted rollout is compiled once and reused across samples;
+        # normalization matches the training pipeline (build_nbody_graph:
+        # charges / charges.max(), no abs)
+        qn_pad = np.zeros((N, 1), np.float32)
+        qn_pad[:n] = (charges[k] / charges[k].max()).astype(np.float32).reshape(n, 1)
+        loc0 = np.zeros((N, 3), np.float32)
+        vel0 = np.zeros((N, 3), np.float32)
+        loc0[:n], vel0[:n] = loc[k, f0], vel[k, f0]
+
+        if params is None:
+            params = _init_params(model, checkpoint, config, seed)
+
+        traj, overflow = rollout(params, jnp.asarray(loc0), jnp.asarray(vel0),
+                                 mask_j, steps, (jnp.asarray(qn_pad),))
+        assert not bool(np.asarray(overflow).any()), "radius-graph capacity overflow"
+        for i, h in enumerate(horizons):
+            pred = np.asarray(traj[i])[:n]
+            mse_acc[h] += float(np.mean((pred - loc[k, h]) ** 2))
+    return {h: mse_acc[h] / num for h in horizons}, steps
+
+
+def _init_params(model, checkpoint, config, seed):
+    """Params from a checkpoint when given, else fresh init (smoke mode)."""
+    import jax
+
+    # init on a minimal batch of the right feature widths (shape-polymorphic
+    # flax init; the rollout batch differs only in N/E)
+    from distegnn_tpu.ops.graph import pad_graphs
+
+    rng = np.random.default_rng(seed)
+    n = 4
+    g = {
+        "node_feat": rng.normal(size=(n, config.model.node_feat_nf)).astype(np.float32),
+        "loc": rng.normal(size=(n, 3)).astype(np.float32),
+        "vel": rng.normal(size=(n, 3)).astype(np.float32),
+        "target": np.zeros((n, 3), np.float32),
+        "edge_index": np.stack([np.arange(n), np.roll(np.arange(n), 1)]).astype(np.int64),
+        "edge_attr": np.ones((n, config.model.edge_attr_nf), np.float32),
+    }
+    params = model.init(jax.random.PRNGKey(seed), pad_graphs([g]))
+    if checkpoint:
+        from distegnn_tpu.train import TrainState, make_optimizer
+        from distegnn_tpu.train.checkpoint import restore_checkpoint
+
+        tx = make_optimizer(1e-3)
+        state = TrainState.create(params, tx)
+        state, _, _ = restore_checkpoint(checkpoint, state)
+        params = state.params
+    return params
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--config_path", required=True)
+    ap.add_argument("--checkpoint", default=None)
+    ap.add_argument("--samples", type=int, default=50)
+    ap.add_argument("--split", default="test")
+    ap.add_argument("--platform", default=None,
+                    help="pin a jax platform (e.g. cpu) before backend init")
+    args = ap.parse_args(argv)
+
+    if args.platform:
+        import jax
+
+        jax.config.update("jax_platforms", args.platform)
+
+    from distegnn_tpu.config import load_config
+
+    config = load_config(args.config_path)
+    horizons, steps = evaluate_nbody_rollout(
+        config, checkpoint=args.checkpoint, samples=args.samples,
+        split=args.split)
+    print(json.dumps({
+        "metric": "rollout_mse",
+        "dataset": config.data.dataset_name,
+        "split": args.split,
+        "samples": args.samples,
+        "steps": steps,
+        "checkpoint": args.checkpoint,
+        "horizons": {str(k): round(v, 6) for k, v in horizons.items()},
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -44,6 +44,35 @@ def test_one_step_matches_host_graph():
                                atol=5e-5)
 
 
+def test_evaluate_rollout_cli(tmp_path):
+    """scripts/evaluate_rollout.py end to end on synthesized tiny n-body
+    trajectory files: emits per-horizon MSEs for every comparable frame."""
+    from scripts.evaluate_rollout import evaluate_nbody_rollout
+    from distegnn_tpu.config import ConfigDict
+
+    rng = np.random.default_rng(1)
+    num, T, n = 2, 50, 12
+    base = tmp_path / "nbody_tiny"
+    base.mkdir()
+    loc = rng.normal(size=(num, T, n, 3)).astype(np.float32)
+    vel = rng.normal(size=(num, T, n, 3)).astype(np.float32) * 0.1
+    q = rng.choice([-1.0, 1.0], size=(num, n, 1)).astype(np.float32)
+    for name, arr in (("loc", loc), ("vel", vel), ("charges", q)):
+        np.save(base / f"{name}_test_tiny.npy", arr)
+
+    config = ConfigDict({
+        "model": {"model_name": "FastEGNN", "node_feat_nf": 2, "node_attr_nf": 0,
+                  "edge_attr_nf": 2, "hidden_nf": 8, "virtual_channels": 2,
+                  "n_layers": 1, "normalize": False},
+        "data": {"data_dir": str(tmp_path), "dataset_name": "nbody_tiny",
+                 "radius": -1.0, "frame_0": 30, "frame_T": 40},
+    })
+    horizons, steps = evaluate_nbody_rollout(config, samples=2, split="test",
+                                             edge_block=256)
+    assert steps == 1 and list(horizons) == [40]
+    assert np.isfinite(horizons[40])
+
+
 def test_multi_step_finite_and_overflow_reported():
     rng, N, loc, vel, model = _setup()
     batch_proto = pad_graphs([{

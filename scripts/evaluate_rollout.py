@@ -21,7 +21,6 @@ Prints one JSON line: {"metric": "rollout_mse", "horizons": {frame: mse}, ...}
 from __future__ import annotations
 
 import argparse
-import glob
 import json
 import os
 import sys
@@ -66,7 +65,7 @@ def evaluate_nbody_rollout(config, checkpoint=None, samples=50, split="test",
     radius = float(config.data.radius)
     if radius <= 0:
         radius = float(np.abs(loc).max()) * 2.0 + 1.0
-    max_degree = _round_up(min(n, 256) - 1, 2)
+    max_degree = max(_round_up(n - 1, 2), 2)
     while (max_degree * edge_block) % 512:
         max_degree += 2
 
@@ -84,7 +83,7 @@ def evaluate_nbody_rollout(config, checkpoint=None, samples=50, split="test",
 
     mask_j = jnp.asarray(node_mask)
     mse_acc = {h: 0.0 for h in horizons}
-    params = None
+    params = _init_params(model, checkpoint, config, seed)
     for k in range(num):
         # charges passed per-sample as a rollout ARGUMENT (not a closure), so
         # the jitted rollout is compiled once and reused across samples;
@@ -96,16 +95,16 @@ def evaluate_nbody_rollout(config, checkpoint=None, samples=50, split="test",
         vel0 = np.zeros((N, 3), np.float32)
         loc0[:n], vel0[:n] = loc[k, f0], vel[k, f0]
 
-        if params is None:
-            params = _init_params(model, checkpoint, config, seed)
-
         traj, overflow = rollout(params, jnp.asarray(loc0), jnp.asarray(vel0),
                                  mask_j, steps, (jnp.asarray(qn_pad),))
-        assert not bool(np.asarray(overflow).any()), "radius-graph capacity overflow"
+        if bool(np.asarray(overflow).any()):
+            raise RuntimeError(
+                f"radius-graph capacity overflow on sample {k} — raise "
+                "max_degree/max_per_cell; MSE from a truncated graph is invalid")
         for i, h in enumerate(horizons):
             pred = np.asarray(traj[i])[:n]
             mse_acc[h] += float(np.mean((pred - loc[k, h]) ** 2))
-    return {h: mse_acc[h] / num for h in horizons}, steps
+    return {h: mse_acc[h] / num for h in horizons}, steps, num
 
 
 def _init_params(model, checkpoint, config, seed):
@@ -156,14 +155,14 @@ def main(argv=None):
     from distegnn_tpu.config import load_config
 
     config = load_config(args.config_path)
-    horizons, steps = evaluate_nbody_rollout(
+    horizons, steps, num = evaluate_nbody_rollout(
         config, checkpoint=args.checkpoint, samples=args.samples,
         split=args.split)
     print(json.dumps({
         "metric": "rollout_mse",
         "dataset": config.data.dataset_name,
         "split": args.split,
-        "samples": args.samples,
+        "samples": num,
         "steps": steps,
         "checkpoint": args.checkpoint,
         "horizons": {str(k): round(v, 6) for k, v in horizons.items()},

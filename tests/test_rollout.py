@@ -44,11 +44,15 @@ def test_one_step_matches_host_graph():
                                atol=5e-5)
 
 
-def test_evaluate_rollout_cli(tmp_path):
-    """scripts/evaluate_rollout.py end to end on synthesized tiny n-body
-    trajectory files: emits per-horizon MSEs for every comparable frame."""
-    from scripts.evaluate_rollout import evaluate_nbody_rollout
-    from distegnn_tpu.config import ConfigDict
+def test_evaluate_rollout_cli(tmp_path, capsys):
+    """scripts/evaluate_rollout.py end to end — main() with argv on
+    synthesized tiny n-body trajectory files: emits one JSON line with a
+    per-horizon MSE for every comparable frame."""
+    import json
+
+    import yaml
+
+    from scripts.evaluate_rollout import main as eval_main
 
     rng = np.random.default_rng(1)
     num, T, n = 2, 50, 12
@@ -60,17 +64,22 @@ def test_evaluate_rollout_cli(tmp_path):
     for name, arr in (("loc", loc), ("vel", vel), ("charges", q)):
         np.save(base / f"{name}_test_tiny.npy", arr)
 
-    config = ConfigDict({
+    cfg = {
         "model": {"model_name": "FastEGNN", "node_feat_nf": 2, "node_attr_nf": 0,
                   "edge_attr_nf": 2, "hidden_nf": 8, "virtual_channels": 2,
                   "n_layers": 1, "normalize": False},
         "data": {"data_dir": str(tmp_path), "dataset_name": "nbody_tiny",
                  "radius": -1.0, "frame_0": 30, "frame_T": 40},
-    })
-    horizons, steps = evaluate_nbody_rollout(config, samples=2, split="test",
-                                             edge_block=256)
-    assert steps == 1 and list(horizons) == [40]
-    assert np.isfinite(horizons[40])
+    }
+    cfg_path = tmp_path / "cfg.yaml"
+    cfg_path.write_text(yaml.safe_dump(cfg))
+
+    # --samples larger than the dataset: output must report the real count
+    eval_main(["--config_path", str(cfg_path), "--samples", "5"])
+    rec = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert rec["metric"] == "rollout_mse"
+    assert rec["samples"] == 2 and rec["steps"] == 1
+    assert np.isfinite(rec["horizons"]["40"])
 
 
 def test_multi_step_finite_and_overflow_reported():

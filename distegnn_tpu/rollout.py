@@ -47,6 +47,7 @@ def make_rollout_fn(
     node_attr: Optional[jnp.ndarray] = None,   # [N, A] static per-node attrs
     edge_block: int = 256,
     velocity_from_delta: bool = True,
+    velocity_scale: float = 1.0,
 ):
     """Build jit-ready ``rollout(params, loc0, vel0, node_mask, steps)``.
 
@@ -101,7 +102,12 @@ def make_rollout_fn(
         def body(carry, _):
             x, v = carry
             x_next, overflow = one_step(params, x, v, node_mask, feat_args)
-            v_next = (x_next - x) if velocity_from_delta else v
+            # velocity_scale: converts the per-rollout-step displacement into
+            # the velocity convention the model was trained on (e.g. the
+            # Water-3D pipeline's velocity is the ONE-frame delta while a
+            # rollout step spans delta_t frames -> scale = 1/delta_t)
+            v_next = ((x_next - x) * velocity_scale if velocity_from_delta
+                      else v)
             return (x_next, v_next), (x_next, overflow)
 
         _, (traj, over) = jax.lax.scan(body, (loc0, vel0), None, length=steps)

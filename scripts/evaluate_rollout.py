@@ -6,10 +6,15 @@ on-device rollout (distegnn_tpu/rollout.py: predict -> rebuild the radius
 graph on device -> next step, all inside one lax.scan) against ground-truth
 trajectory frames and reports MSE per horizon.
 
-Currently wired for the n-body datasets (raw loc/vel/charges .npy
-trajectories; full graph emulated with a radius larger than the system).
-Fluid/Water trajectories work through the same make_rollout_fn — add their
-raw-trajectory loaders here when evaluating those.
+Wired datasets (dispatch on config data.dataset_name):
+  nbody*   — raw loc/vel/charges .npy trajectories; full graph emulated with
+             a radius larger than the system; horizons keyed by FRAME index.
+  Water-3D — h5 trajectories, multi-step (--max-steps) radius-graph rollout;
+             horizons keyed by rollout STEP (each spanning delta_t frames);
+             rollout displacement rescaled to the pipeline's one-frame
+             velocity convention.
+Fluid113K trajectories ride the same make_rollout_fn — add a zstd/msgpack
+loader here when evaluating those.
 
 Usage:
   python scripts/evaluate_rollout.py --config_path configs/nbody_fastegnn.yaml \
@@ -70,14 +75,10 @@ def evaluate_nbody_rollout(config, checkpoint=None, samples=50, split="test",
         max_degree += 2
 
     model = get_model(config.model, dataset_name=config.data.dataset_name)
-
-    def feature_fn(v, qn):
-        speed = jnp.linalg.norm(v, axis=-1, keepdims=True)
-        return jnp.concatenate([speed, qn], axis=-1)
-
     rollout = jax.jit(
         make_rollout_fn(model, radius=radius, max_degree=max_degree,
-                        max_per_cell=N, feature_fn=feature_fn,
+                        max_per_cell=N,
+                        feature_fn=_speed_plus_static_feature(),
                         edge_block=edge_block),
         static_argnums=(4,))
 
@@ -105,6 +106,109 @@ def evaluate_nbody_rollout(config, checkpoint=None, samples=50, split="test",
             pred = np.asarray(traj[i])[:n]
             mse_acc[h] += float(np.mean((pred - loc[k, h]) ** 2))
     return {h: mse_acc[h] / num for h in horizons}, steps, num
+
+
+def _speed_plus_static_feature():
+    """The shared rollout feature_fn: [|v|, static channel] — the canonical
+    conventions live in the training pipelines (nbody.py build_nbody_graph:
+    [|v|, q/q.max]; water3d.py build_water3d_graph: [|v|, type/type.max]);
+    the static channel is precomputed per sample with exactly those
+    normalizations and passed as a rollout feat_arg."""
+    import jax.numpy as jnp
+
+    def feature_fn(v, static):
+        speed = jnp.linalg.norm(v, axis=-1, keepdims=True)
+        return jnp.concatenate([speed, static], axis=-1)
+
+    return feature_fn
+
+
+def evaluate_water3d_rollout(config, checkpoint=None, samples=4, split="test",
+                             edge_block=256, seed=0, max_steps=5,
+                             degree_margin=2.0):
+    """Multi-step rollout over Water-3D h5 trajectories; returns
+    ({step_index: mse}, steps, num_trajectories). Each rollout step spans
+    ``delta_t`` frames starting at frame 0; velocities follow the training
+    convention (one-frame position delta), so the rollout's displacement is
+    rescaled by 1/delta_t."""
+    import h5py
+    import jax
+    import jax.numpy as jnp
+
+    from distegnn_tpu.models.registry import get_model
+    from distegnn_tpu.ops.graph import _round_up
+    from distegnn_tpu.ops.radius import radius_graph_np
+    from distegnn_tpu.rollout import make_rollout_fn
+
+    radius = float(config.data.radius)
+    delta = int(config.data.delta_t)
+    trajs, t_min = [], None
+    with h5py.File(os.path.join(config.data.data_dir, config.data.dataset_name,
+                                f"{split}.h5"), "r") as f:
+        for key in sorted(f.keys())[:samples]:
+            T = f[key]["position"].shape[0]
+            t_min = T if t_min is None else min(t_min, T)
+            # partial read: a rollout of max_steps only touches the first
+            # max_steps*delta + 1 frames (vel0 needs frame 1)
+            pos = np.asarray(f[key]["position"][:max_steps * delta + 1], np.float32)
+            trajs.append((pos, np.asarray(f[key]["particle_type"], np.float32)))
+    if not trajs:
+        raise ValueError("no trajectories in the h5 file")
+
+    # a step k needs target frame k*delta and vel0 needs frame 1 (T >= 2)
+    steps = min(max_steps, (t_min - 1) // delta)
+    if steps < 1 or t_min < 2:
+        raise ValueError(
+            f"trajectories too short for one rollout step of delta_t={delta} "
+            f"(shortest has {t_min} frames)")
+    n_max = max(p.shape[1] for p, _ in trajs)
+    N = _round_up(n_max, edge_block)
+
+    # degree capacity from the data: max observed first-frame degree x margin
+    deg0 = 1
+    for pos, _ in trajs:
+        ei = radius_graph_np(pos[0], radius)
+        deg = np.bincount(ei[0], minlength=pos.shape[1]).max() if ei.size else 1
+        deg0 = max(deg0, int(deg))
+    max_degree = _round_up(int(deg0 * degree_margin) + 1, 2)
+    while (max_degree * edge_block) % 512:
+        max_degree += 2
+
+    model = get_model(config.model, dataset_name=config.data.dataset_name)
+    rollout = jax.jit(
+        make_rollout_fn(model, radius=radius, max_degree=max_degree,
+                        # a radius-r cell can hold at most ~a node's whole
+                        # neighborhood, so calibrate from the same measured
+                        # degree as max_degree
+                        max_per_cell=max(int(deg0 * degree_margin), 32),
+                        feature_fn=_speed_plus_static_feature(),
+                        edge_block=edge_block,
+                        velocity_scale=1.0 / delta),
+        static_argnums=(4,))
+
+    params = _init_params(model, checkpoint, config, seed)
+    mse_acc = {k: 0.0 for k in range(1, steps + 1)}
+    for pos, ptype in trajs:
+        n = pos.shape[1]
+        mask = np.zeros((N,), np.float32)
+        mask[:n] = 1.0
+        tn = np.zeros((N, 1), np.float32)
+        tn[:n, 0] = ptype / max(float(ptype.max()), 1e-12)
+        loc0 = np.zeros((N, 3), np.float32)
+        vel0 = np.zeros((N, 3), np.float32)
+        loc0[:n] = pos[0]
+        vel0[:n] = pos[1] - pos[0]
+        traj, overflow = rollout(params, jnp.asarray(loc0), jnp.asarray(vel0),
+                                 jnp.asarray(mask), steps, (jnp.asarray(tn),))
+        if bool(np.asarray(overflow).any()):
+            raise RuntimeError(
+                "radius-graph capacity overflow — re-run with a larger "
+                "--degree-margin; MSE from a truncated graph is invalid")
+        for k in range(1, steps + 1):
+            pred = np.asarray(traj[k - 1])[:n]
+            mse_acc[k] += float(np.mean((pred - pos[k * delta]) ** 2))
+    num = len(trajs)
+    return {k: v / num for k, v in mse_acc.items()}, steps, num
 
 
 def _init_params(model, checkpoint, config, seed):
@@ -143,6 +247,10 @@ def main(argv=None):
     ap.add_argument("--checkpoint", default=None)
     ap.add_argument("--samples", type=int, default=50)
     ap.add_argument("--split", default="test")
+    ap.add_argument("--max-steps", type=int, default=5,
+                    help="rollout horizon cap (trajectory datasets)")
+    ap.add_argument("--degree-margin", type=float, default=2.0,
+                    help="radius-graph capacity = observed degree x margin")
     ap.add_argument("--platform", default=None,
                     help="pin a jax platform (e.g. cpu) before backend init")
     args = ap.parse_args(argv)
@@ -155,12 +263,22 @@ def main(argv=None):
     from distegnn_tpu.config import load_config
 
     config = load_config(args.config_path)
-    horizons, steps, num = evaluate_nbody_rollout(
-        config, checkpoint=args.checkpoint, samples=args.samples,
-        split=args.split)
+    name = config.data.dataset_name
+    if name.startswith("nbody"):
+        horizons, steps, num = evaluate_nbody_rollout(
+            config, checkpoint=args.checkpoint, samples=args.samples,
+            split=args.split)
+    elif name == "Water-3D":
+        horizons, steps, num = evaluate_water3d_rollout(
+            config, checkpoint=args.checkpoint, samples=args.samples,
+            split=args.split, max_steps=args.max_steps,
+            degree_margin=args.degree_margin)
+    else:
+        raise SystemExit(f"no rollout evaluator wired for dataset {name!r} "
+                         "(supported: nbody*, Water-3D)")
     print(json.dumps({
         "metric": "rollout_mse",
-        "dataset": config.data.dataset_name,
+        "dataset": name,
         "split": args.split,
         "samples": num,
         "steps": steps,

@@ -82,6 +82,40 @@ def test_evaluate_rollout_cli(tmp_path, capsys):
     assert np.isfinite(rec["horizons"]["40"])
 
 
+def test_evaluate_water3d_rollout(tmp_path):
+    """Water-3D multi-step rollout eval on a synthetic h5: per-step horizons,
+    velocity convention rescaled by 1/delta_t."""
+    import h5py
+
+    from scripts.evaluate_rollout import evaluate_water3d_rollout
+    from distegnn_tpu.config import ConfigDict
+
+    rng = np.random.default_rng(2)
+    base = tmp_path / "Water-3D"
+    base.mkdir()
+    T, n = 14, 20
+    with h5py.File(base / "test.h5", "w") as f:
+        for i in range(2):
+            pos0 = rng.uniform(0, 0.4, size=(n, 3)).astype(np.float32)
+            drift = rng.normal(size=(1, n, 3)).astype(np.float32) * 0.002
+            pos = pos0[None] + drift * np.arange(T)[:, None, None]
+            grp = f.create_group(f"traj_{i}")
+            grp["position"] = pos
+            grp["particle_type"] = np.full((n,), 5.0, np.float32)
+
+    config = ConfigDict({
+        "model": {"model_name": "FastEGNN", "node_feat_nf": 2, "node_attr_nf": 0,
+                  "edge_attr_nf": 2, "hidden_nf": 8, "virtual_channels": 2,
+                  "n_layers": 1, "normalize": False},
+        "data": {"data_dir": str(tmp_path), "dataset_name": "Water-3D",
+                 "radius": 0.12, "delta_t": 4},
+    })
+    horizons, steps, num = evaluate_water3d_rollout(config, samples=2,
+                                                    max_steps=3)
+    assert num == 2 and steps == 3 and sorted(horizons) == [1, 2, 3]
+    assert all(np.isfinite(v) for v in horizons.values())
+
+
 def test_multi_step_finite_and_overflow_reported():
     rng, N, loc, vel, model = _setup()
     batch_proto = pad_graphs([{

@@ -505,7 +505,7 @@ def blocked_slot_inv_deg(g, impl: str = "einsum"):
         return None, None, None
     slot = slot_ids(g.row, g.edge_mask, g.edge_block, g.edges_per_block)
     if impl == "einsum":
-        oh = jax.vmap(lambda s: onehot_blocks(s, g.edges_per_block, g.edge_block))(slot)
+        oh = onehot_blocks(slot, g.edges_per_block, g.edge_block)  # [B,nb,epb,blk]
         # in-degree is just a column sum of the incidence (masked slots carry
         # the sentinel and are all-zero one-hot rows already)
         deg = jnp.sum(oh, axis=-2, dtype=jnp.float32).reshape(
@@ -536,7 +536,8 @@ class EdgeOps:
     def gather_rows(self, data):
         if self.blocked:
             if self.oh is not None:
-                return jax.vmap(einsum_gather)(data, self.oh)
+                # the einsum ops are leading-dim polymorphic ('...' batch)
+                return einsum_gather(data, self.oh)
             return blocked_gather(data, self.slot, self.g.edge_block,
                                   self.g.edge_tile)
         return jnp.take_along_axis(data, self.g.row[..., None], axis=1)
@@ -558,7 +559,7 @@ class EdgeOps:
         N = g.max_nodes
         if self.blocked:
             if self.oh is not None:
-                out = jax.vmap(einsum_segment_sum)(data, self.oh)
+                out = einsum_segment_sum(data, self.oh)
             else:
                 out = blocked_segment_sum(data, self.slot, N, g.edge_block,
                                           g.edge_tile)

@@ -78,7 +78,7 @@ def evaluate_nbody_rollout(config, checkpoint=None, samples=50, split="test",
     rollout = jax.jit(
         make_rollout_fn(model, radius=radius, max_degree=max_degree,
                         max_per_cell=N,
-                        feature_fn=_speed_plus_static_feature(),
+                        feature_fn=_speed_plus_static_feature,
                         edge_block=edge_block),
         static_argnums=(4,))
 
@@ -108,7 +108,7 @@ def evaluate_nbody_rollout(config, checkpoint=None, samples=50, split="test",
     return {h: mse_acc[h] / num for h in horizons}, steps, num
 
 
-def _speed_plus_static_feature():
+def _speed_plus_static_feature(v, static):
     """The shared rollout feature_fn: [|v|, static channel] — the canonical
     conventions live in the training pipelines (nbody.py build_nbody_graph:
     [|v|, q/q.max]; water3d.py build_water3d_graph: [|v|, type/type.max]);
@@ -116,11 +116,8 @@ def _speed_plus_static_feature():
     normalizations and passed as a rollout feat_arg."""
     import jax.numpy as jnp
 
-    def feature_fn(v, static):
-        speed = jnp.linalg.norm(v, axis=-1, keepdims=True)
-        return jnp.concatenate([speed, static], axis=-1)
-
-    return feature_fn
+    speed = jnp.linalg.norm(v, axis=-1, keepdims=True)
+    return jnp.concatenate([speed, static], axis=-1)
 
 
 def evaluate_water3d_rollout(config, checkpoint=None, samples=4, split="test",
@@ -181,7 +178,7 @@ def evaluate_water3d_rollout(config, checkpoint=None, samples=4, split="test",
                         # neighborhood, so calibrate from the same measured
                         # degree as max_degree
                         max_per_cell=max(int(deg0 * degree_margin), 32),
-                        feature_fn=_speed_plus_static_feature(),
+                        feature_fn=_speed_plus_static_feature,
                         edge_block=edge_block,
                         velocity_scale=1.0 / delta),
         static_argnums=(4,))

@@ -59,7 +59,7 @@ def make_rollout_fn(
     if (max_degree * edge_block) % 512:
         raise ValueError("max_degree * edge_block must be a multiple of 512")
 
-    def one_step(params, x, v, node_mask, feat_args):
+    def one_step(params, x, v, node_mask, feat_args, attr_now):
         g = radius_graph_dev(x, radius, max_degree, max_per_cell,
                              node_mask=node_mask)
         ei, em = ell_to_edge_list(g)
@@ -67,7 +67,7 @@ def make_rollout_fn(
         nm = node_mask[:, None]
         loc_mean = (jnp.sum(x * nm, axis=0)
                     / jnp.maximum(jnp.sum(node_mask), 1.0))
-        attr = (node_attr if node_attr is not None
+        attr = (attr_now if attr_now is not None
                 else jnp.zeros((N, 0), jnp.float32))
         batch = GraphBatch(
             node_feat=(feature_fn(v, *feat_args) * nm)[None],
@@ -89,19 +89,23 @@ def make_rollout_fn(
         overflow = g.cell_overflow | g.degree_overflow
         return x_next, overflow
 
-    def rollout(params, loc0, vel0, node_mask, steps: int, feat_args=()
+    def rollout(params, loc0, vel0, node_mask, steps: int, feat_args=(),
+                node_attr_now: Optional[jnp.ndarray] = None,
                 ) -> Tuple[jnp.ndarray, jnp.ndarray]:
         """``feat_args``: extra traced arrays forwarded to ``feature_fn(v,
-        *feat_args)`` — per-rollout constants (e.g. charges) passed as
-        arguments instead of closures, so one jitted rollout serves many
-        samples (jit with ``static_argnums=(4,)``)."""
+        *feat_args)``; ``node_attr_now``: per-rollout static node attributes
+        [N, A] (overrides the make-time ``node_attr``) — per-rollout constants
+        passed as arguments instead of closures, so one jitted rollout serves
+        many samples (jit with ``static_argnums=(4,)``)."""
         if loc0.shape[0] % edge_block:
             raise ValueError(f"N={loc0.shape[0]} must be a multiple of "
                              f"edge_block={edge_block} (pad loc0/node_mask)")
+        attr_now = node_attr_now if node_attr_now is not None else node_attr
 
         def body(carry, _):
             x, v = carry
-            x_next, overflow = one_step(params, x, v, node_mask, feat_args)
+            x_next, overflow = one_step(params, x, v, node_mask, feat_args,
+                                        attr_now)
             # velocity_scale: converts the per-rollout-step displacement into
             # the velocity convention the model was trained on (e.g. the
             # Water-3D pipeline's velocity is the ONE-frame delta while a

@@ -13,8 +13,9 @@ Wired datasets (dispatch on config data.dataset_name):
              horizons keyed by rollout STEP (each spanning delta_t frames);
              rollout displacement rescaled to the pipeline's one-frame
              velocity convention.
-Fluid113K trajectories ride the same make_rollout_fn — add a zstd/msgpack
-loader here when evaluating those.
+  Fluid113K — zstd/msgpack simulations (the BASELINE.md headline dataset);
+             horizons keyed by rollout STEP; velocity convention converted
+             with a data-estimated frame duration.
 
 Usage:
   python scripts/evaluate_rollout.py --config_path configs/nbody_fastegnn.yaml \
@@ -161,23 +162,13 @@ def evaluate_water3d_rollout(config, checkpoint=None, samples=4, split="test",
     n_max = max(p.shape[1] for p, _ in trajs)
     N = _round_up(n_max, edge_block)
 
-    # degree capacity from the data: max observed first-frame degree x margin
-    deg0 = 1
-    for pos, _ in trajs:
-        ei = radius_graph_np(pos[0], radius)
-        deg = np.bincount(ei[0], minlength=pos.shape[1]).max() if ei.size else 1
-        deg0 = max(deg0, int(deg))
-    max_degree = _round_up(int(deg0 * degree_margin) + 1, 2)
-    while (max_degree * edge_block) % 512:
-        max_degree += 2
+    max_degree, max_per_cell = _calibrate_degree(
+        (pos[0] for pos, _ in trajs), radius, edge_block, degree_margin)
 
     model = get_model(config.model, dataset_name=config.data.dataset_name)
     rollout = jax.jit(
         make_rollout_fn(model, radius=radius, max_degree=max_degree,
-                        # a radius-r cell can hold at most ~a node's whole
-                        # neighborhood, so calibrate from the same measured
-                        # degree as max_degree
-                        max_per_cell=max(int(deg0 * degree_margin), 32),
+                        max_per_cell=max_per_cell,
                         feature_fn=_speed_plus_static_feature,
                         edge_block=edge_block,
                         velocity_scale=1.0 / delta),
@@ -198,13 +189,131 @@ def evaluate_water3d_rollout(config, checkpoint=None, samples=4, split="test",
         traj, overflow = rollout(params, jnp.asarray(loc0), jnp.asarray(vel0),
                                  jnp.asarray(mask), steps, (jnp.asarray(tn),))
         if bool(np.asarray(overflow).any()):
-            raise RuntimeError(
-                "radius-graph capacity overflow — re-run with a larger "
-                "--degree-margin; MSE from a truncated graph is invalid")
+            raise RuntimeError(_OVERFLOW_MSG)
         for k in range(1, steps + 1):
             pred = np.asarray(traj[k - 1])[:n]
             mse_acc[k] += float(np.mean((pred - pos[k * delta]) ** 2))
     num = len(trajs)
+    return {k: v / num for k, v in mse_acc.items()}, steps, num
+
+
+def _calibrate_degree(first_frames, radius, edge_block, margin):
+    """(max_degree, max_per_cell) for the on-device radius graph, from the
+    max observed first-frame degree x safety margin, 512-aligned for the
+    blocked layout."""
+    from distegnn_tpu.ops.graph import _round_up
+    from distegnn_tpu.ops.radius import radius_graph_np
+
+    deg0 = 1
+    for pos0 in first_frames:
+        ei = radius_graph_np(pos0, radius)
+        deg = np.bincount(ei[0], minlength=pos0.shape[0]).max() if ei.size else 1
+        deg0 = max(deg0, int(deg))
+    max_degree = _round_up(int(deg0 * margin) + 1, 2)
+    while (max_degree * edge_block) % 512:
+        max_degree += 2
+    return max_degree, max(int(deg0 * margin), 32)
+
+
+_OVERFLOW_MSG = ("radius-graph capacity overflow — re-run with a larger "
+                 "--degree-margin; MSE from a truncated graph is invalid")
+
+
+def _static_plus_speed_feature(v, static):
+    """Fluid113K's rollout feature_fn: [viscosity, mass, |v|] — static
+    channels FIRST, matching build_fluid_graph (data/fluid113k.py:118-119)."""
+    import jax.numpy as jnp
+
+    speed = jnp.linalg.norm(v, axis=-1, keepdims=True)
+    return jnp.concatenate([static, speed], axis=-1)
+
+
+def evaluate_fluid113k_rollout(config, checkpoint=None, samples=2, split="test",
+                               edge_block=256, seed=0, max_steps=5,
+                               degree_margin=2.0):
+    """Multi-step rollout over Fluid113K (LargeFluid) simulations — the
+    BASELINE.md headline dataset. Horizons keyed by rollout step (delta_t
+    frames each, starting at frame 0). The sim's own velocity field is the
+    model input; the rollout's delta_t-frame displacement is converted back
+    to that convention with a data-estimated frame duration."""
+    import jax
+    import jax.numpy as jnp
+
+    from distegnn_tpu.data.fluid113k import SIM_SPLITS, read_sim
+    from distegnn_tpu.models.registry import get_model
+    from distegnn_tpu.ops.graph import _round_up
+    from distegnn_tpu.ops.radius import radius_graph_np
+    from distegnn_tpu.rollout import make_rollout_fn
+
+    delta = int(config.data.delta_t)
+    radius = float(config.data.inner_radius or config.data.radius)
+    lo, hi = SIM_SPLITS[split]
+    sims = []
+    for idx in range(lo, min(lo + samples, hi)):
+        try:
+            sims.append(read_sim(config.data.data_dir,
+                                 config.data.dataset_name, idx))
+        except FileNotFoundError:
+            break
+    if not sims:
+        raise ValueError(f"no {split} simulations found under "
+                         f"{config.data.data_dir}/{config.data.dataset_name}")
+
+    t_min = min(pos.shape[0] for pos, _, _, _ in sims)
+    steps = min(max_steps, (t_min - 1) // delta)
+    if steps < 1:
+        raise ValueError(
+            f"simulations too short for one rollout step of delta_t={delta} "
+            f"(shortest has {t_min} frames)")
+    n_max = max(pos.shape[1] for pos, _, _, _ in sims)
+    N = _round_up(n_max, edge_block)
+
+    # frame duration estimated from the data: |pos[1]-pos[0]| ~ |vel[0]|*dt
+    dts = []
+    for pos, vel, _, _ in sims:
+        dx = np.linalg.norm(pos[1] - pos[0], axis=1)
+        v0 = np.linalg.norm(vel[0], axis=1)
+        ok = v0 > 1e-8
+        if ok.any():
+            dts.append(float(np.median(dx[ok] / v0[ok])))
+    frame_dt = float(np.median(dts)) if dts else 1.0
+
+    max_degree, max_per_cell = _calibrate_degree(
+        (pos[0] for pos, _, _, _ in sims), radius, edge_block, degree_margin)
+
+    model = get_model(config.model, dataset_name=config.data.dataset_name)
+    rollout = jax.jit(
+        make_rollout_fn(model, radius=radius, max_degree=max_degree,
+                        max_per_cell=max_per_cell,
+                        feature_fn=_static_plus_speed_feature,
+                        edge_block=edge_block,
+                        velocity_scale=1.0 / (delta * frame_dt)),
+        static_argnums=(4,))
+
+    params = _init_params(model, checkpoint, config, seed)
+    mse_acc = {k: 0.0 for k in range(1, steps + 1)}
+    for pos, vel, viscosity, mass in sims:
+        n = pos.shape[1]
+        mask = np.zeros((N,), np.float32)
+        mask[:n] = 1.0
+        attr = np.zeros((N, 2), np.float32)
+        attr[:n, 0] = viscosity
+        attr[:n, 1] = mass
+        loc0 = np.zeros((N, 3), np.float32)
+        vel0 = np.zeros((N, 3), np.float32)
+        loc0[:n], vel0[:n] = pos[0], vel[0]
+        attr_j = jnp.asarray(attr)
+        # attr enters BOTH as node_feat channels (feature_fn) and as the
+        # model's node_attr input (node_attr_nf=2 in the largefluid config)
+        traj, overflow = rollout(params, jnp.asarray(loc0), jnp.asarray(vel0),
+                                 jnp.asarray(mask), steps, (attr_j,),
+                                 node_attr_now=attr_j)
+        if bool(np.asarray(overflow).any()):
+            raise RuntimeError(_OVERFLOW_MSG)
+        for k in range(1, steps + 1):
+            pred = np.asarray(traj[k - 1])[:n]
+            mse_acc[k] += float(np.mean((pred - pos[k * delta]) ** 2))
+    num = len(sims)
     return {k: v / num for k, v in mse_acc.items()}, steps, num
 
 
@@ -220,6 +329,7 @@ def _init_params(model, checkpoint, config, seed):
     n = 4
     g = {
         "node_feat": rng.normal(size=(n, config.model.node_feat_nf)).astype(np.float32),
+        "node_attr": np.ones((n, int(config.model.get("node_attr_nf", 0))), np.float32),
         "loc": rng.normal(size=(n, 3)).astype(np.float32),
         "vel": rng.normal(size=(n, 3)).astype(np.float32),
         "target": np.zeros((n, 3), np.float32),
@@ -270,9 +380,14 @@ def main(argv=None):
             config, checkpoint=args.checkpoint, samples=args.samples,
             split=args.split, max_steps=args.max_steps,
             degree_margin=args.degree_margin)
+    elif name == "Fluid113K":
+        horizons, steps, num = evaluate_fluid113k_rollout(
+            config, checkpoint=args.checkpoint, samples=args.samples,
+            split=args.split, max_steps=args.max_steps,
+            degree_margin=args.degree_margin)
     else:
         raise SystemExit(f"no rollout evaluator wired for dataset {name!r} "
-                         "(supported: nbody*, Water-3D)")
+                         "(supported: nbody*, Water-3D, Fluid113K)")
     print(json.dumps({
         "metric": "rollout_mse",
         "dataset": name,

@@ -116,6 +116,64 @@ def test_evaluate_water3d_rollout(tmp_path):
     assert all(np.isfinite(v) for v in horizons.values())
 
 
+def test_evaluate_fluid113k_rollout(tmp_path):
+    """Fluid113K (LargeFluid) rollout eval on format-identical synthetic
+    shards: per-step horizons over the zstd/msgpack simulations."""
+    from scripts.evaluate_rollout import evaluate_fluid113k_rollout
+    from scripts.generate_fluid_synthetic import synth_sim
+    from distegnn_tpu.config import ConfigDict
+    from distegnn_tpu.data.fluid113k import SIM_SPLITS, write_fluid_sim
+
+    rng = np.random.default_rng(3)
+    n, frames, radius = 60, 13, 0.25
+    lo, _ = SIM_SPLITS["test"]
+    for i in range(2):
+        pos, vel = synth_sim(rng, n, frames, radius)
+        write_fluid_sim(str(tmp_path), "Fluid113K", lo + i, pos, vel,
+                        np.full((n,), 0.01, np.float32),
+                        np.full((n,), 0.1, np.float32))
+
+    config = ConfigDict({
+        "model": {"model_name": "FastEGNN", "node_feat_nf": 3, "node_attr_nf": 2,
+                  "edge_attr_nf": 2, "hidden_nf": 8, "virtual_channels": 2,
+                  "n_layers": 1, "normalize": False},
+        "data": {"data_dir": str(tmp_path), "dataset_name": "Fluid113K",
+                 "radius": radius, "inner_radius": radius, "delta_t": 4},
+    })
+    horizons, steps, num = evaluate_fluid113k_rollout(config, samples=2,
+                                                      max_steps=2)
+    assert num == 2 and steps == 2 and sorted(horizons) == [1, 2]
+    assert all(np.isfinite(v) for v in horizons.values())
+
+    # checkpoint path: a TRAINED largefluid-shaped model (node_attr_nf=2)
+    # must restore into the evaluator's init tree — catches any width drift
+    # between the rollout batch and the training batch (node_attr included)
+    from distegnn_tpu.models.registry import get_model
+    from distegnn_tpu.train import TrainState, make_optimizer
+    from distegnn_tpu.train.checkpoint import save_checkpoint
+
+    from distegnn_tpu.data.fluid113k import build_fluid_graph
+    from distegnn_tpu.ops.graph import pad_graphs
+    from distegnn_tpu.ops.radius import radius_graph_np
+
+    pos, vel = synth_sim(rng, n, frames, radius)
+    g = build_fluid_graph(pos[0], vel[0], np.full((n,), 0.01, np.float32),
+                          np.full((n,), 0.1, np.float32), pos[4])
+    g["edge_index"] = radius_graph_np(pos[0], radius)
+    d = np.linalg.norm(pos[0][g["edge_index"][0]] - pos[0][g["edge_index"][1]], axis=1)
+    g["edge_attr"] = np.repeat(d[:, None].astype(np.float32), 2, axis=1)
+    model = get_model(config.model, dataset_name="Fluid113K")
+    import jax as _jax
+
+    params = model.init(_jax.random.PRNGKey(1), pad_graphs([g]))
+    tx = make_optimizer(1e-3)
+    ckpt = str(tmp_path / "ck" / "best_model.ckpt")
+    save_checkpoint(ckpt, TrainState.create(params, tx), epoch=1)
+    horizons2, _, _ = evaluate_fluid113k_rollout(config, checkpoint=ckpt,
+                                                 samples=1, max_steps=1)
+    assert np.isfinite(horizons2[1])
+
+
 def test_multi_step_finite_and_overflow_reported():
     rng, N, loc, vel, model = _setup()
     batch_proto = pad_graphs([{

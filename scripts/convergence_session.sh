@@ -1,0 +1,37 @@
+#!/usr/bin/env bash
+# N-body convergence run + artifact capture (BASELINE.md MSE-parity evidence).
+#
+# Run on a live TPU tunnel (CPU epochs are ~15+ min on this host; TPU epochs
+# with scan_epochs are sub-second). Produces:
+#   - logs/nbody/<exp>/log.json            (loss curves, best MSEs, time_cost)
+#   - docs/artifacts/nbody_fastegnn_log.json  (tracked copy; logs/ is ignored)
+#   - docs/artifacts/nbody_rollout_mse.json   (rollout MSE with the best ckpt)
+#
+# Usage: bash scripts/convergence_session.sh [epochs]   (default: full 2500)
+
+set -eu
+cd "$(dirname "$0")/.."
+EPOCHS=${1:-2500}
+
+timeout 60 python -c "
+import jax, jax.numpy as jnp
+print('probe ok', float((jnp.ones((256,256))@jnp.ones((256,256))).sum()))" \
+  || { echo "TPU wedged; aborting (do not run this on CPU)"; exit 2; }
+
+test -f data/n_body_system/nbody_100/loc_train_charged100_0_0_1.npy \
+  || { echo "dataset missing; run scripts/generate_nbody_chunked.py first"; exit 3; }
+
+python -u main.py --config_path configs/nbody_fastegnn.yaml --epochs "$EPOCHS" \
+  2>&1 | tee /tmp/convergence_run.log
+
+# newest run dir under logs/nbody
+EXP=$(ls -dt logs/nbody/*/ | head -1)
+mkdir -p docs/artifacts
+cp "$EXP/log.json" docs/artifacts/nbody_fastegnn_log.json
+CKPT="$EXP/state_dict/best_model.ckpt"
+if [ -f "$CKPT" ]; then
+  python scripts/evaluate_rollout.py --config_path configs/nbody_fastegnn.yaml \
+    --checkpoint "$CKPT" --samples 200 \
+    > docs/artifacts/nbody_rollout_mse.json
+fi
+echo "artifacts written under docs/artifacts/ — record the best MSEs in BASELINE.md and commit"

@@ -135,7 +135,6 @@ def evaluate_water3d_rollout(config, checkpoint=None, samples=4, split="test",
 
     from distegnn_tpu.models.registry import get_model
     from distegnn_tpu.ops.graph import _round_up
-    from distegnn_tpu.ops.radius import radius_graph_np
     from distegnn_tpu.rollout import make_rollout_fn
 
     radius = float(config.data.radius)
@@ -242,7 +241,6 @@ def evaluate_fluid113k_rollout(config, checkpoint=None, samples=2, split="test",
     from distegnn_tpu.data.fluid113k import SIM_SPLITS, read_sim
     from distegnn_tpu.models.registry import get_model
     from distegnn_tpu.ops.graph import _round_up
-    from distegnn_tpu.ops.radius import radius_graph_np
     from distegnn_tpu.rollout import make_rollout_fn
 
     delta = int(config.data.delta_t)
@@ -251,10 +249,16 @@ def evaluate_fluid113k_rollout(config, checkpoint=None, samples=2, split="test",
     sims = []
     for idx in range(lo, min(lo + samples, hi)):
         try:
-            sims.append(read_sim(config.data.data_dir,
-                                 config.data.dataset_name, idx))
+            pos, vel, visc, mass = read_sim(config.data.data_dir,
+                                            config.data.dataset_name, idx)
         except FileNotFoundError:
             break
+        # keep only the frames a rollout touches (read_sim has no partial
+        # read — shards are whole-file zstd — but the stacked tail can be
+        # dropped immediately: frames 0..max_steps*delta)
+        keep = max_steps * int(config.data.delta_t) + 1
+        sims.append((pos[:keep] if pos.shape[0] > keep else pos,
+                     vel[:1], visc, mass))
     if not sims:
         raise ValueError(f"no {split} simulations found under "
                          f"{config.data.data_dir}/{config.data.dataset_name}")
@@ -268,7 +272,9 @@ def evaluate_fluid113k_rollout(config, checkpoint=None, samples=2, split="test",
     n_max = max(pos.shape[1] for pos, _, _, _ in sims)
     N = _round_up(n_max, edge_block)
 
-    # frame duration estimated from the data: |pos[1]-pos[0]| ~ |vel[0]|*dt
+    # frame duration estimated from the data: |pos[1]-pos[0]| ~ |vel[0]|*dt.
+    # A degenerate estimate means the velocity convention cannot be recovered
+    # and any MSE would be silently wrong — refuse, like the overflow path.
     dts = []
     for pos, vel, _, _ in sims:
         dx = np.linalg.norm(pos[1] - pos[0], axis=1)
@@ -276,7 +282,12 @@ def evaluate_fluid113k_rollout(config, checkpoint=None, samples=2, split="test",
         ok = v0 > 1e-8
         if ok.any():
             dts.append(float(np.median(dx[ok] / v0[ok])))
-    frame_dt = float(np.median(dts)) if dts else 1.0
+    frame_dt = float(np.median(dts)) if dts else 0.0
+    if not np.isfinite(frame_dt) or frame_dt <= 0:
+        raise ValueError(
+            "cannot estimate the frame duration from the data (static first "
+            "frames or zero velocities) — the rollout velocity convention "
+            "would be wrong; check the simulation dump")
 
     max_degree, max_per_cell = _calibrate_degree(
         (pos[0] for pos, _, _, _ in sims), radius, edge_block, degree_margin)

@@ -20,6 +20,12 @@ import jax
 import numpy as np
 
 
+def _fmt(loss: float) -> str:
+    """Loss for humans: fixed-point at ordinary scales, scientific once the
+    value would round to 0.00000 (e.g. tiny-displacement fluid targets)."""
+    return f"{loss:.5f}" if loss >= 1e-4 else f"{loss:.3e}"
+
+
 def run_epoch_train(train_step: Callable, state, loader, seed: int, epoch: int):
     """One training epoch. Returns (state, avg loss) — the average of the
     per-step node-weighted global MSE weighted by batch size (reference
@@ -135,11 +141,11 @@ def train(
                     wandb_run.log({"loss_train": loss_train, "loss_valid": loss_valid,
                                    "loss_test": loss_test, "epoch_time": dt_epoch},
                                   step=epoch)
-                print(f"Epoch {epoch} | train {loss_train:.5f} | "
-                      f"valid {loss_valid:.5f} | test {loss_test:.5f} | "
+                print(f"Epoch {epoch} | train {_fmt(loss_train)} | "
+                      f"valid {_fmt(loss_valid)} | test {_fmt(loss_test)} | "
                       f"{dt_epoch:.2f}s/epoch", flush=True)
-                print(f"*** Best Valid Loss: {best['loss_valid']:.5f} | "
-                      f"Best Test Loss: {best['loss_test']:.5f} | "
+                print(f"*** Best Valid Loss: {_fmt(best['loss_valid'])} | "
+                      f"Best Test Loss: {_fmt(best['loss_test'])} | "
                       f"Best Epoch Index: {best['epoch_index']}", flush=True)
 
         elif is_main and log and wandb_run is not None:

@@ -1,0 +1,72 @@
+#!/usr/bin/env python
+"""Generate random SPlisHSPlasH fluid scenes (Fluid113K generation stage 1).
+
+In-tree port of the reference's create_physics_scenes.py CLI
+(dataset_generation/Fluid113K/create_physics_scenes.py:439-497): synthesizes
+the scene directory (scene.json + box/fluid bgeo) with numpy-only mesh
+sampling, then — when a SPlisHSPlasH ``DynamicBoundarySimulator`` binary is
+available (--simulator-bin or $SIMULATOR_BIN) — runs the simulation so
+``scripts/pack_fluid_records.py`` can pack the exported frames. Without the
+binary the scene directories are still complete and portable.
+
+The reference generates sims 1..140 (train 1-100 / valid 101-120 /
+test 121-140, fluid113k.SIM_SPLITS) with ~113k particles each:
+
+    for seed in $(seq 1 140); do
+        python scripts/generate_fluid_scenes.py --output data/fluid_scenes \
+            --seed $seed --simulator-bin $SIMULATOR_BIN
+    done
+"""
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+
+def main():
+    p = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    p.add_argument("--output", required=True, help="output directory")
+    p.add_argument("--seed", type=int, required=True, help="scene RNG seed (= sim id)")
+    p.add_argument("--uniform-viscosity", action="store_true")
+    p.add_argument("--log10-uniform-viscosity", action="store_true")
+    p.add_argument("--default-viscosity", action="store_true")
+    p.add_argument("--default-density", action="store_true")
+    p.add_argument("--num-objects", type=int, default=0,
+                   help="fluid object count; 0 = random 1-3")
+    p.add_argument("--const-fluid-particles", type=int, default=0)
+    p.add_argument("--max-fluid-particles", type=int, default=0)
+    p.add_argument("--min-fluid-particles", type=int, default=100_000,
+                   help="reject scenes below this budget (reference asserts >100k)")
+    p.add_argument("--radius", type=float, default=0.025)
+    p.add_argument("--simulator-bin", default=os.environ.get("SIMULATOR_BIN", ""),
+                   help="SPlisHSPlasH DynamicBoundarySimulator path; scene-only if unset")
+    args = p.parse_args()
+
+    from distegnn_tpu.data.fluid_scenes import run_simulator, synthesize_scene
+
+    os.makedirs(args.output, exist_ok=True)
+    sim_dir = synthesize_scene(
+        args.output, args.seed, radius=args.radius,
+        num_objects=args.num_objects,
+        uniform_viscosity=args.uniform_viscosity,
+        log10_uniform_viscosity=args.log10_uniform_viscosity,
+        default_viscosity=args.default_viscosity,
+        default_density=args.default_density,
+        const_fluid_particles=args.const_fluid_particles,
+        max_fluid_particles=args.max_fluid_particles,
+        min_fluid_particles=args.min_fluid_particles)
+    print(f"scene written: {sim_dir}")
+
+    if args.simulator_bin:
+        rc = run_simulator(args.simulator_bin, sim_dir)
+        print(f"simulator exit code {rc}; exports under {sim_dir}/partio/")
+        return rc
+    print("no --simulator-bin: scene-only mode (run SPlisHSPlasH elsewhere, "
+          "then scripts/pack_fluid_records.py)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

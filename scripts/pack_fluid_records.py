@@ -1,0 +1,52 @@
+#!/usr/bin/env python
+"""Pack simulated fluid scenes into training shards (Fluid113K stage 2).
+
+In-tree port of the reference's create_physics_records.py CLI
+(dataset_generation/Fluid113K/create_physics_records.py:108-148): every
+``sim_*/partio`` directory under --input becomes 16 ``sim_XXXX_YY.msgpack.zst``
+shards under --output — exactly what ``distegnn_tpu.data.fluid113k.read_sim``
+(and the reference trainer) consumes.
+
+    python scripts/pack_fluid_records.py \
+        --input data/fluid_scenes --output data/LargeFluid/Fluid113K
+"""
+
+import argparse
+import glob
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+
+def main():
+    p = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    p.add_argument("--input", required=True, help="directory of sim_* scene dirs")
+    p.add_argument("--output", required=True, help="shard output directory")
+    p.add_argument("--splits", type=int, default=16,
+                   help="shards per simulation (default 16 = fluid113k.SHARDS_PER_SIM)")
+    p.add_argument("--radius", type=float, default=0.025)
+    args = p.parse_args()
+
+    from distegnn_tpu.data.fluid_scenes import pack_scene_records
+
+    os.makedirs(args.output, exist_ok=True)
+    scene_dirs = sorted(glob.glob(os.path.join(args.input, "sim_*")))
+    if not scene_dirs:
+        print(f"no sim_* directories under {args.input}", file=sys.stderr)
+        return 1
+    for scene_dir in scene_dirs:
+        name = os.path.basename(scene_dir)
+        try:
+            shards = pack_scene_records(scene_dir, name,
+                                        os.path.join(args.output, name),
+                                        splits=args.splits, radius=args.radius)
+        except FileNotFoundError as e:
+            print(f"skipping {name}: {e}", file=sys.stderr)
+            continue
+        print(f"{name}: {len(shards)} shards")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

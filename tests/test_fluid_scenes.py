@@ -1,0 +1,263 @@
+"""Fluid113K offline generation pipeline (distegnn_tpu/data/fluid_scenes.py,
+bgeo.py) — the in-tree port of the reference's SPlisHSPlasH scene synthesis
+(create_physics_scenes.py) and record packing (create_physics_records.py).
+The external simulator is exercised with a synthetic partio export dir."""
+
+import gzip
+import json
+import os
+
+import numpy as np
+import pytest
+
+from distegnn_tpu.data.bgeo import (list_partio_frames, numpy_from_bgeo,
+                                    read_bgeo, write_bgeo,
+                                    write_bgeo_from_numpy)
+from distegnn_tpu.data.fluid_scenes import (PARTICLE_RADIUS, box_mesh,
+                                            find_valid_fluid_start_positions,
+                                            load_obj, pack_scene_records,
+                                            points_inside_mesh,
+                                            random_rotation_matrix,
+                                            rasterize_points, sample_surface,
+                                            sample_volume, synthesize_scene,
+                                            write_obj)
+
+R_TEST = 0.1  # coarse particle radius so tests run in milliseconds
+
+
+def test_bgeo_roundtrip(tmp_path, rng):
+    pos = rng.standard_normal((37, 3)).astype(np.float32)
+    vel = rng.standard_normal((37, 3)).astype(np.float32)
+    dens = rng.random(37).astype(np.float32)
+    ids = rng.permutation(37).astype(np.int64)
+    path = str(tmp_path / "p.bgeo")
+    write_bgeo(path, pos, {"velocity": vel, "density": dens, "id": ids})
+    out = read_bgeo(path)
+    np.testing.assert_allclose(out["position"], pos, rtol=1e-6)
+    np.testing.assert_allclose(out["velocity"], vel, rtol=1e-6)
+    np.testing.assert_allclose(out["density"], dens, rtol=1e-6)
+    np.testing.assert_array_equal(out["id"], ids)
+
+
+def test_bgeo_gzip_and_id_sort(tmp_path, rng):
+    """numpy_from_bgeo restores id order (SPlisHSPlasH exports shuffle
+    particles; reference physics_data_helper.py:42-57 sorts by id) and
+    partio's transparent gzip is honored."""
+    n = 20
+    pos = rng.standard_normal((n, 3)).astype(np.float32)
+    vel = rng.standard_normal((n, 3)).astype(np.float32)
+    perm = rng.permutation(n)
+    path = str(tmp_path / "f.bgeo")
+    write_bgeo(path, pos[perm], {"velocity": vel[perm], "id": perm.astype(np.int64)})
+    # gzip the same payload under a plain .bgeo name (partio sniffs magic)
+    with open(path, "rb") as f:
+        payload = f.read()
+    gz_path = str(tmp_path / "g.bgeo")
+    with open(gz_path, "wb") as f:
+        f.write(gzip.compress(payload))
+    for p in (path, gz_path):
+        out_pos, out_vel = numpy_from_bgeo(p)
+        np.testing.assert_allclose(out_pos, pos, rtol=1e-6)
+        np.testing.assert_allclose(out_vel, vel, rtol=1e-6)
+
+
+def test_bgeo_rejects_garbage(tmp_path):
+    path = str(tmp_path / "bad.bgeo")
+    with open(path, "wb") as f:
+        f.write(b"not a bgeo file at all")
+    with pytest.raises(ValueError, match="magic"):
+        read_bgeo(path)
+
+
+def test_obj_roundtrip(tmp_path):
+    verts, tris = box_mesh((2.0, 3.0, 4.0))
+    path = str(tmp_path / "box.obj")
+    write_obj(path, verts, tris)
+    v2, t2 = load_obj(path)
+    np.testing.assert_allclose(v2, verts, atol=1e-6)
+    np.testing.assert_array_equal(t2, tris)
+
+
+def test_points_inside_mesh():
+    verts, tris = box_mesh((2.0, 2.0, 2.0))  # x,z in [-1,1], y in [0,2]
+    pts = np.array([[0, 1, 0], [0.9, 0.1, -0.9], [1.5, 1, 0], [0, 2.5, 0],
+                    [0, -0.1, 0]], np.float64)
+    np.testing.assert_array_equal(points_inside_mesh(pts, verts, tris),
+                                  [True, True, False, False, False])
+
+
+def test_sample_volume_grid_density():
+    verts, tris = box_mesh((2.0, 2.0, 2.0))
+    pts = sample_volume(verts, tris, radius=R_TEST)
+    # 2r grid inset by r: floor((2 - 2r) / 2r) + 1 = 10 per axis
+    assert pts.shape == (1000, 3)
+    assert points_inside_mesh(pts.astype(np.float64), verts, tris).all()
+    # scale shrinks the sampled volume with the mesh
+    assert sample_volume(verts, tris, scale=0.5, radius=R_TEST).shape[0] < 300
+
+
+def test_sample_surface_on_surface_inward_normals():
+    verts, tris = box_mesh((2.0, 2.0, 2.0))
+    pts, nrm = sample_surface(verts, tris, radius=R_TEST)
+    area = 6 * 2.0 * 2.0
+    target = int(1.9 * area / (np.pi * R_TEST**2))
+    assert pts.shape[0] > 0.5 * target  # thinning keeps most of the budget
+    # every sample lies on one of the six faces
+    on_x = np.isclose(np.abs(pts[:, 0]), 1.0, atol=1e-5)
+    on_y = np.isclose(pts[:, 1], 0.0, atol=1e-5) | np.isclose(pts[:, 1], 2.0, atol=1e-5)
+    on_z = np.isclose(np.abs(pts[:, 2]), 1.0, atol=1e-5)
+    assert (on_x | on_y | on_z).all()
+    np.testing.assert_allclose(np.linalg.norm(nrm, axis=1), 1.0, atol=1e-5)
+    # inward: stepping along the normal stays/enters the box interior
+    inside = points_inside_mesh((pts + 0.05 * nrm).astype(np.float64), verts, tris)
+    assert inside.mean() > 0.99
+
+
+def test_random_rotation_is_rotation(rng):
+    for _ in range(5):
+        R = random_rotation_matrix(rng)
+        np.testing.assert_allclose(R @ R.T, np.eye(3), atol=1e-5)
+        assert np.linalg.det(R) == pytest.approx(1.0, abs=1e-5)
+
+
+def test_rasterize_points_marks_extent(rng):
+    pts = rng.uniform(0, 1, (50, 3)).astype(np.float32)
+    arr_min, voxel, occ = rasterize_points(pts, 2.01 * R_TEST, R_TEST)
+    assert occ.any()
+    # every particle's own voxel is marked
+    idx = np.floor_divide(pts, voxel).astype(np.int32) - arr_min
+    assert occ[idx[:, 0], idx[:, 1], idx[:, 2]].all()
+    with pytest.raises(ValueError):
+        rasterize_points(pts, R_TEST, R_TEST)  # voxel too small
+
+
+def test_find_valid_positions_lowest_and_carve(rng):
+    # free space: 10^3 grid fully free; fluid: 3^3 block
+    box = (np.zeros(3, np.int32), 0.5, np.ones((10, 10, 10), dtype=bool))
+    fluid = (np.zeros(3, np.int32), 0.5, np.ones((3, 3, 3), dtype=bool))
+    sel = find_valid_fluid_start_positions(box, fluid, rng)
+    assert sel[1] == 0.0  # lowest feasible y in an empty box is the floor
+    assert (~box[2]).sum() == 27  # chosen volume carved out of free space
+    # a second, identical placement cannot overlap the carved region
+    sel2 = find_valid_fluid_start_positions(box, fluid, rng)
+    assert (~box[2]).sum() == 54
+    assert not np.allclose(sel, sel2)
+
+
+def test_find_valid_positions_too_large(rng):
+    box = (np.zeros(3, np.int32), 0.5, np.ones((4, 4, 4), dtype=bool))
+    fluid = (np.zeros(3, np.int32), 0.5, np.ones((6, 6, 6), dtype=bool))
+    with pytest.raises(ValueError):
+        find_valid_fluid_start_positions(box, fluid, rng)
+
+
+@pytest.fixture(scope="module")
+def scene_dir(tmp_path_factory):
+    out = str(tmp_path_factory.mktemp("scenes"))
+    sim = synthesize_scene(out, seed=7, radius=R_TEST, num_objects=2,
+                           min_fluid_particles=500)
+    return sim
+
+
+def test_synthesize_scene_layout(scene_dir):
+    with open(os.path.join(scene_dir, "scene.json")) as f:
+        scene = json.load(f)
+    assert scene["Configuration"]["particleRadius"] == R_TEST
+    assert scene["RigidBodies"][0]["geometryFile"] == "box.obj"
+    assert len(scene["FluidModels"]) == 2
+    box_pts, box_nrm = numpy_from_bgeo(os.path.join(scene_dir, "box.bgeo"))
+    assert box_pts.shape == box_nrm.shape and box_pts.shape[0] > 100
+    verts, tris = box_mesh()
+    total = 0
+    for fm in scene["FluidModels"]:
+        fid = fm["id"]
+        assert 0.01 <= scene[fid]["viscosity"]
+        assert 500 <= scene[fid]["density0"] <= 2000
+        pos, vel = numpy_from_bgeo(os.path.join(scene_dir, fm["particleFile"]))
+        total += pos.shape[0]
+        # placed fluid sits inside the container, above the floor
+        assert points_inside_mesh(pos.astype(np.float64), verts, tris).mean() > 0.95
+        # per-object constant start velocity within the reference bounds
+        assert np.ptp(vel, axis=0).max() < 1e-6
+        assert np.abs(vel[0, [0, 2]]).max() <= 4.0 and abs(vel[0, 1]) <= 1.0
+    assert total >= 500
+
+
+def test_synthesize_scene_deterministic(tmp_path, scene_dir):
+    sim2 = synthesize_scene(str(tmp_path), seed=7, radius=R_TEST, num_objects=2,
+                            min_fluid_particles=500)
+    a, _ = numpy_from_bgeo(os.path.join(scene_dir, "fluid0.bgeo"))
+    b, _ = numpy_from_bgeo(os.path.join(sim2, "fluid0.bgeo"))
+    np.testing.assert_allclose(a, b)
+
+
+def test_synthesize_scene_particle_budgets(tmp_path):
+    sim = synthesize_scene(str(tmp_path), seed=11, radius=R_TEST, num_objects=2,
+                           const_fluid_particles=900, min_fluid_particles=100)
+    with open(os.path.join(sim, "scene.json")) as f:
+        scene = json.load(f)
+    total = sum(numpy_from_bgeo(os.path.join(sim, fm["particleFile"]))[0].shape[0]
+                for fm in scene["FluidModels"])
+    assert total == 900
+    with pytest.raises(RuntimeError, match="particles"):
+        synthesize_scene(str(tmp_path), seed=12, radius=R_TEST,
+                         min_fluid_particles=10**9)
+
+
+def test_pack_records_to_training_format(scene_dir, tmp_path, rng):
+    """Synthetic partio exports -> shards -> read_sim: the full stage-2 path
+    without the external simulator binary."""
+    from distegnn_tpu.data.fluid113k import read_sim
+
+    with open(os.path.join(scene_dir, "scene.json")) as f:
+        scene = json.load(f)
+    partio = os.path.join(scene_dir, "partio")
+    os.makedirs(partio, exist_ok=True)
+    T = 32
+    truth = {}
+    for fm in scene["FluidModels"]:
+        fid = fm["id"]
+        pos0, vel0 = numpy_from_bgeo(os.path.join(scene_dir, fm["particleFile"]))
+        n = pos0.shape[0]
+        frames = []
+        for t in range(T):
+            pos_t = pos0 + 0.01 * t * vel0
+            perm = rng.permutation(n)  # simulator exports shuffle particles
+            write_bgeo(os.path.join(partio, f"ParticleData_{fid}_{t}.bgeo"),
+                       pos_t[perm], {"velocity": vel0[perm],
+                                     "id": perm.astype(np.int64)})
+            frames.append(pos_t)
+        truth[fid] = np.stack(frames)
+
+    out = str(tmp_path / "records")
+    os.makedirs(out)
+    shards = pack_scene_records(scene_dir, "sim_0007",
+                                os.path.join(out, "sim_0001"), radius=R_TEST)
+    assert len(shards) == 16 and all(os.path.isfile(s) for s in shards)
+
+    pos, vel, visc, mass = read_sim(str(tmp_path), "records", 1)
+    fluid_ids = sorted(truth)
+    expect_pos = np.concatenate([truth[f] for f in fluid_ids], axis=1)
+    assert pos.shape == (T, expect_pos.shape[1], 3)
+    np.testing.assert_allclose(pos, expect_pos, atol=1e-5)
+    # node constants: per-fluid viscosity and mass = density0 * (2r)^3
+    expect_visc = np.concatenate(
+        [np.full(truth[f].shape[1], scene[f]["viscosity"]) for f in fluid_ids])
+    expect_mass = np.concatenate(
+        [np.full(truth[f].shape[1], scene[f]["density0"] * (2 * R_TEST) ** 3)
+         for f in fluid_ids])
+    np.testing.assert_allclose(visc, expect_visc, rtol=1e-5)
+    np.testing.assert_allclose(mass, expect_mass, rtol=1e-5)
+    assert vel.shape == pos.shape
+
+
+def test_list_partio_frames_ordering(tmp_path):
+    d = str(tmp_path)
+    for t in (10, 2, 0):  # out-of-order creation; numeric (not lexical) sort
+        write_bgeo(os.path.join(d, f"ParticleData_fluid0_{t}.bgeo"),
+                   np.zeros((1, 3), np.float32))
+    frames = list_partio_frames(d)
+    assert list(frames) == ["fluid0"]
+    assert [os.path.basename(p) for p in frames["fluid0"]] == [
+        "ParticleData_fluid0_0.bgeo", "ParticleData_fluid0_2.bgeo",
+        "ParticleData_fluid0_10.bgeo"]

@@ -8,12 +8,15 @@ radius-0.075 edges, batch 1, FastEGNN hidden 64 / 4 layers / C=3 with MMD
 configuration on one chip.
 
 Layouts (docs/PERFORMANCE.md):
-  plain   — row-sorted padded edge list, XLA scatter/gather aggregation
-  blocked — blocked-CSR layout, one-hot contraction ops (ops/blocked.py;
-            --impl einsum|pallas selects the lowering)
-Default is auto: measure blocked-einsum AND plain, each in a child process
-(so a compiler surprise on new hardware cannot take down the bench), and
-report the faster real measurement.
+  plain        — row-sorted padded edge list, XLA scatter/gather aggregation
+  plain-cumsum — same layout, --seg cumsum: scatter-free prefix-sum
+                 aggregations with gather-only VJPs (ops/segment.py)
+  blocked      — blocked-CSR layout, one-hot contraction ops (ops/blocked.py;
+                 --impl einsum|pallas selects the lowering); hardware-measured
+                 slower than plain, kept for explicit runs only
+Default is auto: measure plain-cumsum AND plain-scatter, each in a child
+process (so a compiler surprise on new hardware cannot take down the bench),
+and report the faster real measurement.
 
 Timing methodology (v2, round 2 — see BASELINE.md "Measurement integrity"):
 round 1 timed a donated jit with jax.block_until_ready, which RETURNS EARLY
@@ -43,13 +46,17 @@ RADIUS = 0.075
 TARGET_EDGES_PER_NODE = 15.0
 HIDDEN, LAYERS, CHANNELS = 64, 4, 3
 WARMUP, STEPS = 3, 10
-CHILD_TIMEOUT_S = 900
+# Child kill is a last resort: SIGKILLing a live TPU client strands the
+# remote claim and wedges the axon tunnel (observed twice, BASELINE.md) — but
+# without a bound a wedged tunnel hangs the bench forever. 2400 s clears the
+# slowest observed degraded-session child (~6 min) by 6x.
+CHILD_TIMEOUT_S = 2400
 
 # TPU v5e peak: 197 TFLOP/s bf16, ~98.5 TFLOP/s fp32 (public spec sheet).
 PEAK_F32_FLOPS = 98.5e12
 
 
-def make_fluid_batch(rng, edge_block: int = 0):
+def make_fluid_batch(rng, edge_block: int = 0, pairing: bool = False):
     """Synthetic fluid-like particle cloud at Fluid113K density."""
     from distegnn_tpu.ops.graph import pad_graphs
     from distegnn_tpu.ops.radius import radius_graph_np
@@ -73,28 +80,30 @@ def make_fluid_batch(rng, edge_block: int = 0):
         "edge_index": edge_index,
         "edge_attr": np.repeat(dist[:, None], 2, axis=1).astype(np.float32),
     }
-    kw = {"edge_block": edge_block} if edge_block else {}
+    kw = {"edge_block": edge_block} if edge_block else {"compute_pair": pairing}
     return pad_graphs([graph], **kw), n_edges
 
 
-def layout_tag(edge_block: int, impl: str) -> str:
+def layout_tag(edge_block: int, impl: str, seg: str = "scatter") -> str:
     """The machine-read layout label shared by bench.py and profile_step.py
     outputs (pasted into BASELINE.md tables)."""
-    return f"blocked{edge_block}-{impl}" if edge_block else "plain"
+    if edge_block:
+        return f"blocked{edge_block}-{impl}"
+    return "plain" if seg == "scatter" else f"plain-{seg}"
 
 
-def measure(edge_block: int, impl: str = "einsum"):
+def measure(edge_block: int, impl: str = "einsum", seg: str = "scatter"):
     import jax
 
     from distegnn_tpu.models.fast_egnn import FastEGNN
     from distegnn_tpu.train import TrainState, make_optimizer, make_train_step
 
     rng = np.random.default_rng(0)
-    batch, n_edges = make_fluid_batch(rng, edge_block)
+    batch, n_edges = make_fluid_batch(rng, edge_block, pairing=(seg == "cumsum"))
 
     model = FastEGNN(node_feat_nf=3, node_attr_nf=2, edge_attr_nf=2,
                      hidden_nf=HIDDEN, virtual_channels=CHANNELS, n_layers=LAYERS,
-                     compute_dtype="bf16", blocked_impl=impl)
+                     compute_dtype="bf16", blocked_impl=impl, segment_impl=seg)
     params = model.init(jax.random.PRNGKey(0), batch)
     tx = make_optimizer(5e-4, weight_decay=1e-12, clip_norm=0.3)
     state = TrainState.create(params, tx)
@@ -125,7 +134,7 @@ def measure(edge_block: int, impl: str = "einsum"):
 
     nodes_per_sec = N_NODES * STEPS / dt
     platform = jax.devices()[0].platform
-    layout = layout_tag(edge_block, impl)
+    layout = layout_tag(edge_block, impl, seg)
     official = N_NODES == 113_140  # vs_baseline is meaningless off-workload
     return {
         "metric": "largefluid_train_nodes_per_sec_per_chip",
@@ -148,31 +157,41 @@ def main():
         jax.config.update("jax_platforms", plat)
 
     args = sys.argv[1:]
-    layout, impl = "auto", "einsum"
+    layout, impl, seg = "auto", "einsum", "scatter"
+    usage = ("usage: bench.py [--layout plain|blocked|auto] "
+             "[--impl pallas|einsum] [--seg scatter|cumsum]")
     if "--layout" in args:
         i = args.index("--layout")
         if i + 1 >= len(args) or args[i + 1] not in ("plain", "blocked", "auto"):
-            sys.exit("usage: bench.py [--layout plain|blocked|auto] [--impl pallas|einsum]")
+            sys.exit(usage)
         layout = args[i + 1]
     if "--impl" in args:
         i = args.index("--impl")
         if i + 1 >= len(args) or args[i + 1] not in ("pallas", "einsum"):
-            sys.exit("usage: bench.py [--layout plain|blocked|auto] [--impl pallas|einsum]")
+            sys.exit(usage)
         impl = args[i + 1]
+    if "--seg" in args:
+        i = args.index("--seg")
+        if i + 1 >= len(args) or args[i + 1] not in ("scatter", "cumsum"):
+            sys.exit(usage)
+        seg = args[i + 1]
 
     edge_block = int(os.environ.get("BENCH_EDGE_BLOCK", 256))
     if layout in ("plain", "blocked"):
-        print(json.dumps(measure(edge_block if layout == "blocked" else 0, impl)))
+        print(json.dumps(measure(edge_block if layout == "blocked" else 0,
+                                 impl, seg)))
         return
 
-    # auto: measure BOTH candidate layouts, each in a CHILD process (so a
+    # auto: measure the candidate lowerings, each in a CHILD process (so a
     # compiler surprise on new hardware can't kill the bench), and report the
-    # faster real measurement. Candidates: blocked-einsum (the expected
-    # winner) and plain; blocked-pallas is excluded - hardware-measured
-    # SLOWER than plain (1067.7 vs ~712-773 ms/step, BASELINE.md round-2
-    # status: grid-step overhead swamps the tiny per-step dots).
+    # faster real measurement. Candidates: plain-cumsum (scatter-free
+    # prefix-sum aggregation) and plain-scatter. The blocked layouts are
+    # excluded after losing on hardware twice (BASELINE.md round-2 status:
+    # pallas 1067.7 ms vs plain 712-773; einsum 2462.7 vs plain 1653.5 in the
+    # same degraded-tunnel session) - measure them explicitly with --layout
+    # blocked if revisiting.
     best, fails = None, []
-    for child_args in (["--layout", "blocked", "--impl", impl],
+    for child_args in (["--layout", "plain", "--seg", "cumsum"],
                        ["--layout", "plain"]):
         try:
             out = subprocess.run(

@@ -11,7 +11,7 @@ the TPU-first replacement for ragged PyG batching.
 from __future__ import annotations
 
 import pickle
-from typing import List, Sequence, Union
+from typing import List, Optional, Sequence, Union
 
 import numpy as np
 import jax
@@ -61,7 +61,7 @@ class GraphLoader:
         edge_block: int = 0,
         edges_per_block: int = None,
         edge_tile: int = 512,
-        pairing: bool = None,
+        pairing: Optional[bool] = None,  # None=auto (blocked: symmetry scan; plain: off)
         cache_bytes: int = 2 << 30,
     ):
         self.dataset = dataset
@@ -105,6 +105,12 @@ class GraphLoader:
                       f"edges on host; raise cache_bytes if RAM allows")
         else:
             self.edges_per_block = None
+            # plain layout: pairing=True attaches the reverse-edge involution
+            # to every batch (segment_impl='cumsum' uses it for scatter-free
+            # col-gather backwards). In-tree edge builders emit symmetric
+            # radius/full graphs, so the all-or-nothing per-batch pairing
+            # stays structurally stable across the run.
+            self.pairing = bool(pairing)
             if max_nodes is None or max_edges is None:
                 n, e = dataset.size_maxima()
                 max_nodes = max_nodes if max_nodes is not None else _round_up(n, node_bucket)
@@ -122,7 +128,8 @@ class GraphLoader:
             return dict(edge_block=self.edge_block, edge_tile=self.edge_tile,
                         edges_per_block=self.edges_per_block,
                         max_nodes=self.max_nodes, compute_pair=self.pairing)
-        return dict(max_nodes=self.max_nodes, max_edges=self.max_edges)
+        return dict(max_nodes=self.max_nodes, max_edges=self.max_edges,
+                    compute_pair=self.pairing)
 
     def _graph(self, i: int) -> dict:
         """Fetch graph i, blockified (and cached) when edge_block is on."""
@@ -183,6 +190,7 @@ class ShardedGraphLoader:
         data_parallel: int = 1,
         edge_block: int = 0,
         edge_tile: int = 512,
+        pairing: Optional[bool] = None,  # None=auto (blocked: AND over shard scans; plain: off)
     ):
         sizes = {len(d) for d in datasets}
         if len(sizes) != 1:
@@ -200,7 +208,8 @@ class ShardedGraphLoader:
             N = _round_up(n, edge_block)
             scans = [scan_dataset_for_blocking(d, N, edge_block) for d in datasets]
             epb = _round_up(max(s[0] for s in scans), edge_tile)
-            pairing = all(s[1] for s in scans)
+            if pairing is None:
+                pairing = all(s[1] for s in scans)
             self.loaders = [
                 GraphLoader(
                     d, batch_size * data_parallel, shuffle=shuffle, seed=seed,
@@ -214,6 +223,7 @@ class ShardedGraphLoader:
                 GraphLoader(
                     d, batch_size * data_parallel, shuffle=shuffle, seed=seed,
                     max_nodes=_round_up(n, node_bucket), max_edges=_round_up(e, edge_bucket),
+                    pairing=pairing,
                 )
                 for d in datasets
             ]

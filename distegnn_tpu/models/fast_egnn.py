@@ -60,6 +60,7 @@ class EGCLVel(nn.Module):
     # math, E/N x fewer matmul rows, no [E, 2H+S] concat. False restores the
     # reference-shaped concat MLP (different param tree — not ckpt-compatible)
     hoist_edge_mlp: bool = True
+    seg_impl: str = "scatter"  # plain-layout aggregation lowering ('scatter'|'cumsum')
 
     @nn.compact
     def __call__(
@@ -80,7 +81,7 @@ class EGCLVel(nn.Module):
         node_mask = g.node_mask                      # [B, N]
         edge_mask = g.edge_mask                      # [B, E]
         nm = node_mask[..., None]
-        ops = EdgeOps(g, slot, inv_deg, oh)  # MXU one-hot contractions when blocked
+        ops = EdgeOps(g, slot, inv_deg, oh, seg_impl=self.seg_impl)
 
         # --- real-edge geometry (reference coord2radial, :237-246)
         coord_diff = ops.gather_rows(x) - ops.gather_cols(x)            # [B, E, 3]
@@ -202,6 +203,11 @@ class FastEGNN(nn.Module):
     # forward, ops are batched dots (default — no Pallas grid overhead);
     # 'pallas' = one-hot built in VMEM per kernel
     blocked_impl: str = "einsum"
+    # plain-layout aggregation lowering: 'scatter' (XLA sorted scatter,
+    # bit-exact) or 'cumsum' (scatter-free prefix-sum differences with
+    # gather-only VJPs, ops/segment.py — f32-accumulated, so sums carry
+    # ~|prefix|*eps rounding; pair with compute_dtype='bf16')
+    segment_impl: str = "scatter"
     # recompute each layer's activations in the backward pass instead of
     # keeping them in HBM: layer activations are O(E*H) (hundreds of MB at
     # LargeFluid scale), so remat trades cheap recompute FLOPs for the
@@ -243,6 +249,7 @@ class FastEGNN(nn.Module):
                 axis_name=self.axis_name,
                 compute_dtype=self.compute_dtype,
                 hoist_edge_mlp=self.hoist_edge_mlp,
+                seg_impl=self.segment_impl,
                 name=f"gcl_{i}",
             )(h, x, v, X, Hv, g, gravity=gravity, slot=slot, inv_deg=inv_deg,
               oh=oh)

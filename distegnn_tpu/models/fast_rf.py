@@ -65,6 +65,7 @@ class GCLRFVel(nn.Module):
     virtual_channels: int
     edge_attr_nf: int = 0
     axis_name: Optional[str] = None
+    seg_impl: str = "scatter"
 
     @nn.compact
     def __call__(self, x, v, X, g: GraphBatch, slot=None, inv_deg=None, oh=None
@@ -72,7 +73,7 @@ class GCLRFVel(nn.Module):
         H, C = self.hidden_nf, self.virtual_channels
         node_mask = g.node_mask
         B, N = x.shape[0], x.shape[1]
-        ops = EdgeOps(g, slot, inv_deg, oh)  # MXU one-hot contractions when blocked
+        ops = EdgeOps(g, slot, inv_deg, oh, seg_impl=self.seg_impl)
 
         coord_diff = ops.gather_rows(x) - ops.gather_cols(x)             # [B, E, 3]
         radial = jnp.sum(coord_diff**2, axis=-1, keepdims=True)          # [B, E, 1]
@@ -119,6 +120,7 @@ class FastRF(nn.Module):
     n_layers: int = 4
     axis_name: Optional[str] = None
     blocked_impl: str = "einsum"  # blocked-layout edge-op lowering ('pallas'|'einsum')
+    segment_impl: str = "scatter"  # plain-layout lowering ('scatter'|'cumsum')
 
     @nn.compact
     def __call__(self, g: GraphBatch) -> Tuple[jnp.ndarray, jnp.ndarray]:
@@ -131,6 +133,7 @@ class FastRF(nn.Module):
             x, X = GCLRFVel(
                 hidden_nf=self.hidden_nf, virtual_channels=C,
                 edge_attr_nf=self.edge_attr_nf, axis_name=self.axis_name,
+                seg_impl=self.segment_impl,
                 name=f"gcl_{i}",
             )(x, v, X, g, slot=slot, inv_deg=inv_deg, oh=oh)
         return x, X

@@ -52,6 +52,7 @@ class SchNetGCLVel(nn.Module):
     axis_name: Optional[str] = None
     epsilon: float = 1e-8
     hoist_edge_mlp: bool = True  # phi_e + gate first Dense on the node axis
+    seg_impl: str = "scatter"
 
     @nn.compact
     def __call__(self, h, x, v, X, Hv, g: GraphBatch, gravity=None,
@@ -60,7 +61,7 @@ class SchNetGCLVel(nn.Module):
         node_mask, edge_mask = g.node_mask, g.edge_mask
         nm = node_mask[..., None]
         B, N = h.shape[0], h.shape[1]
-        ops = EdgeOps(g, slot, inv_deg, oh)  # MXU one-hot contractions when blocked
+        ops = EdgeOps(g, slot, inv_deg, oh, seg_impl=self.seg_impl)
 
         # normalize is accepted for config parity but is a no-op here AS IN THE
         # REFERENCE: its coord2radial normalizes coord_diff, which FastSchNet
@@ -166,6 +167,7 @@ class FastSchNet(nn.Module):
     axis_name: Optional[str] = None
     blocked_impl: str = "einsum"  # blocked-layout edge-op lowering ('pallas'|'einsum')
     hoist_edge_mlp: bool = True   # phi_e + gate first Dense on the node axis
+    segment_impl: str = "scatter"  # plain-layout lowering ('scatter'|'cumsum')
 
     @nn.compact
     def __call__(self, g: GraphBatch) -> Tuple[jnp.ndarray, jnp.ndarray]:
@@ -191,6 +193,7 @@ class FastSchNet(nn.Module):
                 attention=self.attention, normalize=self.normalize,
                 tanh=self.tanh, has_gravity=self.gravity is not None,
                 axis_name=self.axis_name, hoist_edge_mlp=self.hoist_edge_mlp,
+                seg_impl=self.segment_impl,
                 name=f"gcl_{i}",
             )(h, x, v, X, Hv, g, gravity=gravity, slot=slot, inv_deg=inv_deg,
               oh=oh)

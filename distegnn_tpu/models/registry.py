@@ -50,6 +50,7 @@ def get_model(model_config, world_size: int = 1, dataset_name: Optional[str] = N
             remat=bool(model_config.get("remat", False)),
             blocked_impl=model_config.get("blocked_impl", "einsum"),
             hoist_edge_mlp=bool(model_config.get("hoist_edge_mlp", True)),
+            segment_impl=model_config.get("segment_impl", "scatter"),
         )
     if name == "FastRF":
         FastRF = _import_model("fast_rf", "FastRF")
@@ -60,6 +61,7 @@ def get_model(model_config, world_size: int = 1, dataset_name: Optional[str] = N
             virtual_channels=model_config.virtual_channels,
             axis_name=axis_name,
             blocked_impl=model_config.get("blocked_impl", "einsum"),
+            segment_impl=model_config.get("segment_impl", "scatter"),
         )
     if name in ("FastSchNet", "SchNet"):
         cutoff = _SCHNET_CUTOFFS.get(dataset_name)
@@ -79,6 +81,7 @@ def get_model(model_config, world_size: int = 1, dataset_name: Optional[str] = N
                 axis_name=axis_name,
                 blocked_impl=model_config.get("blocked_impl", "einsum"),
                 hoist_edge_mlp=bool(model_config.get("hoist_edge_mlp", True)),
+                segment_impl=model_config.get("segment_impl", "scatter"),
             )
         SchNet = _import_model("schnet", "SchNet")
         return SchNet(hidden_channels=model_config.hidden_nf, cutoff=cutoff)

@@ -520,18 +520,31 @@ def blocked_slot_inv_deg(g, impl: str = "einsum"):
 
 
 class EdgeOps:
-    """The one definition of the blocked-vs-XLA edge-op dispatch all model
-    families share: row/col gathers and per-destination aggregations, as MXU
-    one-hot kernels when the batch carries the blocked layout (with the
-    reverse-edge pairing backward when available), XLA sorted-scatter
-    otherwise. ``slot``/``inv_deg``/``oh`` come from
-    :func:`blocked_slot_inv_deg` (hoisted once per forward; plain arrays, so
-    layers stay remat-able). ``oh is not None`` selects the einsum lowering,
-    otherwise the Pallas kernels."""
+    """The one definition of the edge-op dispatch all model families share:
+    row/col gathers and per-destination aggregations, lowered as
 
-    def __init__(self, g, slot=None, inv_deg=None, oh=None):
+      blocked   MXU one-hot kernels when the batch carries the blocked layout
+                (with the reverse-edge pairing backward when available);
+      cumsum    ``seg_impl='cumsum'`` on a plain row-sorted batch: prefix-sum
+                differences with gather-only custom VJPs — no XLA scatter in
+                forward OR backward (ops/segment.py cumsum block);
+      scatter   XLA sorted-scatter otherwise (bit-exact reference path).
+
+    ``slot``/``inv_deg``/``oh`` come from :func:`blocked_slot_inv_deg`
+    (hoisted once per forward; plain arrays, so layers stay remat-able).
+    ``oh is not None`` selects the einsum lowering, otherwise the Pallas
+    kernels."""
+
+    def __init__(self, g, slot=None, inv_deg=None, oh=None,
+                 seg_impl: str = "scatter"):
         self.g, self.slot, self.inv_deg, self.oh = g, slot, inv_deg, oh
         self.blocked = slot is not None
+        if seg_impl not in ("scatter", "cumsum"):
+            raise ValueError(f"unknown seg_impl {seg_impl!r}")
+        # the cumsum lowering needs ascending row ids; keep the exact scatter
+        # path when the batch can't support it
+        self.cumsum = (seg_impl == "cumsum" and not self.blocked
+                       and g.edges_sorted)
 
     def gather_rows(self, data):
         if self.blocked:
@@ -540,6 +553,10 @@ class EdgeOps:
                 return einsum_gather(data, self.oh)
             return blocked_gather(data, self.slot, self.g.edge_block,
                                   self.g.edge_tile)
+        if self.cumsum:
+            from distegnn_tpu.ops.segment import gather_rows_cs
+
+            return jax.vmap(gather_rows_cs)(data, self.g.row)
         return jnp.take_along_axis(data, self.g.row[..., None], axis=1)
 
     def gather_cols(self, data):
@@ -550,10 +567,16 @@ class EdgeOps:
                                                     self.oh)
             return paired_col_gather(data, g.col, g.edge_pair, self.slot,
                                      g.edge_block, g.edge_tile)
+        if self.cumsum and g.edge_pair is not None:
+            from distegnn_tpu.ops.segment import paired_gather_cols_cs
+
+            return jax.vmap(paired_gather_cols_cs)(data, g.col, g.edge_pair,
+                                                   g.row, g.edge_mask)
         return jnp.take_along_axis(data, g.col[..., None], axis=1)
 
     def _agg(self, data, mean: bool):
-        from distegnn_tpu.ops.segment import segment_mean, segment_sum
+        from distegnn_tpu.ops.segment import (segment_mean, segment_mean_cs,
+                                              segment_sum, segment_sum_cs)
 
         g = self.g
         N = g.max_nodes
@@ -566,6 +589,10 @@ class EdgeOps:
             if mean:
                 out = out * self.inv_deg
             return out.astype(data.dtype)
+        if self.cumsum:
+            seg_cs = segment_mean_cs if mean else segment_sum_cs
+            return jax.vmap(lambda t, r, m: seg_cs(t, r, N, mask=m))(
+                data, g.row, g.edge_mask)
         seg = segment_mean if mean else segment_sum
         return jax.vmap(lambda t, r, m: seg(
             t, r, N, mask=m, indices_are_sorted=g.edges_sorted))(

@@ -60,8 +60,9 @@ class GraphBatch:
     edge_index: jnp.ndarray
     edge_attr: jnp.ndarray
     edge_mask: jnp.ndarray
-    # [B, E] reverse-edge involution (symmetric graphs, blocked layout only):
-    # lets backward col-aggregations ride the MXU kernels (ops/blocked.py)
+    # [B, E] reverse-edge involution (symmetric graphs): lets backward
+    # col-aggregations ride the MXU kernels (blocked layout, ops/blocked.py)
+    # or the scatter-free cumsum path (plain sorted layout, ops/segment.py)
     edge_pair: Optional[jnp.ndarray] = None
     edges_sorted: bool = struct.field(pytree_node=False, default=False)
     edge_block: int = struct.field(pytree_node=False, default=0)
@@ -113,7 +114,7 @@ def pad_graphs(
     edge_block: int = 0,
     edges_per_block: Optional[int] = None,
     edge_tile: int = 512,
-    compute_pair: bool = True,
+    compute_pair: Optional[bool] = None,
 ) -> "GraphBatch":
     """Pack a list of per-graph numpy dicts into one padded GraphBatch.
 
@@ -133,9 +134,17 @@ def pad_graphs(
     Partition pipelines MUST pass the global mean explicitly (the partitioners
     in distegnn_tpu.data do), since GraphBatch.loc_mean seeds the replicated
     virtual-node coordinates across devices.
+
+    ``compute_pair`` — attach the reverse-edge involution (``edge_pair``) so
+    backward col-aggregations stay scatter-free. ``None`` (auto) keeps the
+    historical layouts: on for blocked batches, off for plain ones (the plain
+    pairing only pays off with ``segment_impl='cumsum'``; loaders switch it on
+    dataset-stably so every batch shares one pytree structure).
     """
     bsz = len(graphs)
     n_max = max(g["loc"].shape[0] for g in graphs)
+    if compute_pair is None:
+        compute_pair = edge_block > 0
     if edge_block:
         from distegnn_tpu.ops.blocked import (max_block_degree,
                                               prepare_blocked_graph)
@@ -214,6 +223,17 @@ def pad_graphs(
             edge_mask[b, :e] = g["_edge_mask"]  # blocked layout: interior padding
         else:
             edge_mask[b, :e] = 1.0
+
+    if (not edge_block) and compute_pair and edges_sorted:
+        # plain-layout reverse-edge involution over the PADDED lists (padding
+        # slots are (N-1, N-1) self-pairs); all-or-nothing across the batch so
+        # the pytree structure stays stable
+        from distegnn_tpu.ops.blocked import pairing_perm_fast
+
+        pairs = [pairing_perm_fast(edge_index[b].astype(np.int64))
+                 for b in range(bsz)]
+        edge_pair = (np.stack(pairs).astype(np.int32)
+                     if all(p is not None for p in pairs) else None)
 
     return GraphBatch(
         node_feat=node_feat, node_attr=node_attr, loc=loc, vel=vel, target=target,

@@ -223,6 +223,11 @@ def run_distributed(config):
             datasets, d.batch_size, shuffle=(split_idx == 0), seed=config.seed,
             node_bucket=d.node_bucket, edge_bucket=d.edge_bucket,
             data_parallel=dp, edge_block=d.edge_block,
+            # cumsum aggregation wants the reverse-edge pairing attached to
+            # plain batches (scatter-free col-gather backward, ops/segment.py)
+            pairing=(True if (not d.edge_block and
+                              config.model.get("segment_impl") == "cumsum")
+                     else None),
         ), put))
     loader_train, loader_valid, loader_test = loaders
     print(f"Data ready: {len(loader_train.loader.loaders[0].dataset)} graphs x "

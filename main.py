@@ -124,6 +124,10 @@ def main(argv=None):
         ds, config.data.batch_size, shuffle=shuffle, seed=config.seed,
         node_bucket=config.data.node_bucket, edge_bucket=config.data.edge_bucket,
         edge_block=config.data.edge_block,
+        # cumsum aggregation wants the reverse-edge pairing for scatter-free
+        # col-gather backwards (plain layout; ops/segment.py)
+        pairing=(True if (not config.data.edge_block and
+                          config.model.get("segment_impl") == "cumsum") else None),
     )
     loader_train, loader_valid, loader_test = mk(ds_train, True), mk(ds_valid, False), mk(ds_test, False)
 

@@ -27,14 +27,25 @@ import time
 
 import numpy as np
 
-# Pin the CPU backend BEFORE any backend initializes: the integrator runs in
-# jnp, and on axon-tunnel hosts the env var JAX_PLATFORMS alone does not stop
-# the tunnel backend from initializing (its get_backend hook initializes all
-# discovered platforms) — a wedged tunnel then hangs this offline generator.
-# config.update is honored; same pattern as tests/conftest.py.
+# Pin the backend BEFORE it initializes. Default is CPU: on axon-tunnel hosts
+# the env var JAX_PLATFORMS alone does not stop the tunnel backend from
+# initializing (its get_backend hook initializes all discovered platforms) —
+# a wedged tunnel then hangs this offline generator. config.update is honored;
+# same pattern as tests/conftest.py. ``--platform tpu`` opts back into the
+# chip when the tunnel is alive (the jitted scan integrator makes the full
+# 9,000-trajectory dataset a ~3-minute job there vs hours on one CPU core).
 import jax  # noqa: E402
 
-jax.config.update("jax_platforms", "cpu")
+_plat = "cpu"
+for _i, _a in enumerate(sys.argv):
+    if _a == "--platform":
+        if _i + 1 >= len(sys.argv):
+            sys.exit("--platform requires a value (cpu|tpu|auto)")
+        _plat = sys.argv[_i + 1]
+    elif _a.startswith("--platform="):
+        _plat = _a.split("=", 1)[1]
+if _plat != "auto":
+    jax.config.update("jax_platforms", _plat)
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
@@ -55,6 +66,8 @@ def main():
     p.add_argument("--clusters", type=int, default=1)
     p.add_argument("--seed", type=int, default=43)
     p.add_argument("--budget", type=float, default=480.0)
+    p.add_argument("--platform", type=str, default="cpu",
+                   help="jax backend: cpu (default, tunnel-safe) | tpu | auto")
     args = p.parse_args()
 
     tag = f"charged{args.n_isolated}_0_0_{args.clusters}"

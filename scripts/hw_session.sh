@@ -3,11 +3,21 @@
 #
 # The axon tunnel wedges unpredictably (BASELINE.md), so when a window opens
 # every pending measurement should run unattended, serially, with the host
-# otherwise idle. This script:
-#   1. probes the TPU (60 s timeout) and exits 2 if wedged;
+# otherwise idle. IMPORTANT: SIGTERM/SIGKILL of a live TPU client strands the
+# remote claim and wedges the tunnel for everyone (observed 2026-07-29 and
+# again 2026-07-30 when a 25-min `timeout` killed profile_step) — so items
+# run with NO kill timeout; a wedged tunnel hangs the queue instead of
+# corrupting it, and the probe guards entry.
+#
+# This script:
+#   1. probes the TPU (60 s timeout; a never-acquired client is safe to kill)
+#      and exits 2 if wedged;
 #   2. SIGSTOPs any running n-body generator (host contention degrades step
 #      timing ~4x — BASELINE.md measurement discipline), resuming it on exit;
-#   3. runs the measurement queue, appending JSON/readable output to $LOG.
+#   3. runs the measurement queue, appending output to $LOG;
+#   4. finishes the n-body dataset on-chip and hands off to the convergence
+#      run (scripts/convergence_session.sh) — the remaining MSE-parity
+#      evidence (BASELINE.md round-2 status).
 #
 # Usage: bash scripts/hw_session.sh [logfile]   (default /tmp/hw_session.log)
 
@@ -33,22 +43,37 @@ resume() { [ -n "$GEN_PIDS" ] && kill -CONT $GEN_PIDS 2>/dev/null; }
 trap resume EXIT
 [ -n "$GEN_PIDS" ] && kill -STOP $GEN_PIDS 2>/dev/null
 
-run() {  # run <label> <timeout_s> <cmd...>
-  local label=$1 to=$2; shift 2
+run() {  # run <label> <cmd...> — NO kill timeout (see header)
+  local label=$1; shift
   echo "--- $label ($(date -u +%T)) ---" >>"$LOG"
-  timeout "$to" "$@" >>"$LOG" 2>&1
+  "$@" >>"$LOG" 2>&1
   echo "--- $label rc=$? ---" >>"$LOG"
 }
 
-# 1. isolate the primitives: Pallas tile sweep + einsum variants
-run microbench 2400 python scripts/microbench_blocked.py
-# 2. headline bench: einsum blocked (256 and 128), plain control
-run bench_einsum_256 1200 python bench.py --layout blocked --impl einsum
-run bench_einsum_128 1200 env BENCH_EDGE_BLOCK=128 \
-  python bench.py --layout blocked --impl einsum
-run bench_plain 1200 python bench.py --layout plain
-# 3. step breakdown on the best-known layout
-run profile_einsum 1200 python scripts/profile_step.py --bf16 --edge-block 256
-run profile_plain 1200 python scripts/profile_step.py --bf16
+# 1. isolate the segment-sum lowerings (decides bench's default path)
+run microbench_segsum python scripts/microbench_segsum.py
+run microbench_segsum_bf16 python scripts/microbench_segsum.py --bf16
+# 2. headline bench: auto = plain-cumsum vs plain-scatter in child processes
+run bench_auto python bench.py
+# 3. step breakdown on both plain lowerings
+run profile_cumsum python scripts/profile_step.py --bf16 --seg cumsum
+run profile_plain python scripts/profile_step.py --bf16
+
+# 4. finish the n-body dataset on-chip (resumes any CPU-generated chunks)
+#    and run the convergence session (MSE-parity evidence). The CPU generator
+#    is SIGSTOPped: queue TERM first, then CONT so it can die (a TERM alone
+#    stays pending on a stopped process forever); chunk writes are atomic
+#    (tmp + rename), so termination mid-chunk cannot corrupt the dataset.
+if [ -n "$GEN_PIDS" ]; then
+  kill -TERM $GEN_PIDS 2>/dev/null
+  kill -CONT $GEN_PIDS 2>/dev/null
+  sleep 2
+  GEN_PIDS=""
+fi
+run nbody_gen_tpu python scripts/generate_nbody_chunked.py \
+  --path data/n_body_system/nbody_100 --n_isolated 100 \
+  --num-train 5000 --num-valid 2000 --num-test 2000 --seed 43 \
+  --budget 100000 --platform tpu
+run convergence bash scripts/convergence_session.sh
 
 echo "=== hw_session done $(date -u +%FT%TZ) ===" >>"$LOG"

@@ -66,6 +66,8 @@ def main():
                     help="blocked edge layout (0 = plain)")
     ap.add_argument("--impl", default="einsum", choices=["einsum", "pallas"],
                     help="blocked-op lowering (with --edge-block)")
+    ap.add_argument("--seg", default="scatter", choices=["scatter", "cumsum"],
+                    help="plain-layout aggregation lowering")
     args = ap.parse_args()
 
     import jax
@@ -79,14 +81,15 @@ def main():
     from distegnn_tpu.train.loss import masked_mse, mmd_loss
 
     rng = np.random.default_rng(0)
-    batch, n_edges = make_fluid_batch(rng, edge_block=args.edge_block)
+    batch, n_edges = make_fluid_batch(rng, edge_block=args.edge_block,
+                                      pairing=(args.seg == "cumsum"))
     dev = jax.devices()[0]
     batch = jax.device_put(batch, dev)
 
     model = FastEGNN(node_feat_nf=3, node_attr_nf=2, edge_attr_nf=2,
                      hidden_nf=HIDDEN, virtual_channels=CHANNELS, n_layers=LAYERS,
                      compute_dtype="bf16" if args.bf16 else None,
-                     blocked_impl=args.impl)
+                     blocked_impl=args.impl, segment_impl=args.seg)
     params = model.init(jax.random.PRNGKey(0), batch)
     tx = make_optimizer(5e-4, weight_decay=1e-12, clip_norm=0.3)
     state = TrainState.create(params, tx)
@@ -114,7 +117,7 @@ def main():
 
     res = {"n_nodes": args.nodes, "n_edges": int(n_edges),
            "platform": dev.platform, "device": str(dev.device_kind),
-           "layout": layout_tag(args.edge_block, args.impl)}
+           "layout": layout_tag(args.edge_block, args.impl, args.seg)}
     res["t_forward_ms"] = timed(fwd, params, batch, steps=args.steps) * 1e3
     res["t_grad_ms"] = timed(grad_fn, params, batch, key, steps=args.steps) * 1e3
     res["t_step_full_ms"] = timed(step_mmd, state, batch, key, steps=args.steps) * 1e3

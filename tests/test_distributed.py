@@ -227,3 +227,28 @@ def test_sharded_loader_with_distributed_step(dist_setup):
     for batch in sl:
         state, metrics = train_P(state, batch, jax.random.PRNGKey(0))
         assert np.isfinite(float(metrics["loss"]))
+
+
+def test_distributed_cumsum_matches_scatter(dist_setup):
+    """segment_impl='cumsum' under shard_map (vmapped searchsorted/cumsum +
+    psum virtual-node sync) matches the scatter lowering on the same
+    partition stack."""
+    _, model_P, params, _, _, mesh, parts = dist_setup
+    n_max = max(p["loc"].shape[0] for p in parts)
+    e_max = max(p["edge_index"].shape[1] for p in parts)
+    part_batches = [pad_graphs([p], max_nodes=n_max + 2, max_edges=e_max + 8,
+                               compute_pair=True) for p in parts]
+    stacked = jax.tree.map(lambda *xs: np.stack(xs, axis=0), *part_batches)
+    assert stacked.edge_pair is not None
+
+    def fwd_of(m):
+        return jax.jit(jax.shard_map(
+            lambda pr, b: m.apply(pr, jax.tree.map(lambda x: x[0], b)),
+            mesh=mesh, in_specs=(P(), P(GRAPH_AXIS)),
+            out_specs=(P(GRAPH_AXIS), P()), check_vma=False,
+        ))
+
+    loc_sc, X_sc = fwd_of(model_P)(params, stacked)
+    loc_cs, X_cs = fwd_of(model_P.copy(segment_impl="cumsum"))(params, stacked)
+    np.testing.assert_allclose(np.asarray(X_cs), np.asarray(X_sc), atol=1e-4)
+    np.testing.assert_allclose(np.asarray(loc_cs), np.asarray(loc_sc), atol=1e-4)

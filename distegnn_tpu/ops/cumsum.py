@@ -1,0 +1,81 @@
+"""One-pass prefix sum along the leading axis (TPU Pallas, XLA fallback).
+
+The cumsum segment lowering (ops/segment.py) stands or falls with the cost
+of the prefix sum itself: XLA lowers a length-E cumsum into O(log E) shifted
+adds — ~21 full-array passes at LargeFluid scale (E=1.6M), which can burn
+more HBM traffic than the scatter it replaces. A sequential Pallas kernel
+does it in ONE pass: the TPU grid executes in order, so a [1, F] VMEM
+scratch carries the running total from tile to tile (read data once, write
+prefix once). This is the *right* shape of Pallas kernel for this chip —
+long streaming reduction — unlike the tiny-dot one-hot kernels that
+hardware measurement refuted (docs/PERFORMANCE.md).
+
+`prefix_sum(x)` always returns float32 prefix sums (accumulation precision —
+see the segment lowering's accuracy note). `impl='auto'` (default) picks the
+Pallas kernel on TPU for long axes and XLA elsewhere; the env var
+``DISTEGNN_PREFIX_IMPL=xla|pallas`` overrides it for A/B measurement
+(scripts/microbench_segsum.py).
+"""
+
+from __future__ import annotations
+
+import functools
+import os
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+_TILE = 4096          # rows per grid step: [4096, 64] f32 = 1 MiB VMEM block
+_MIN_PALLAS_ROWS = 32768  # below this the dispatch isn't worth it
+
+
+def _use_interpret() -> bool:
+    return jax.default_backend() != "tpu"
+
+
+def _prefix_kernel(x_ref, out_ref, carry_ref):
+    i = pl.program_id(0)
+
+    @pl.when(i == 0)
+    def _():
+        carry_ref[...] = jnp.zeros_like(carry_ref)
+
+    c = jnp.cumsum(x_ref[...].astype(jnp.float32), axis=0) + carry_ref[...]
+    out_ref[...] = c
+    carry_ref[...] = c[-1:]
+
+
+@functools.partial(jax.jit, static_argnames=("tile",))
+def _prefix_pallas(x, tile: int = _TILE):
+    E, F = x.shape
+    n_tiles = -(-E // tile)
+    pad = n_tiles * tile - E
+    if pad:
+        x = jnp.concatenate([x, jnp.zeros((pad, F), x.dtype)], axis=0)
+    out = pl.pallas_call(
+        _prefix_kernel,
+        grid=(n_tiles,),
+        in_specs=[pl.BlockSpec((tile, F), lambda i: (i, 0),
+                               memory_space=pltpu.VMEM)],
+        out_specs=pl.BlockSpec((tile, F), lambda i: (i, 0),
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((n_tiles * tile, F), jnp.float32),
+        scratch_shapes=[pltpu.VMEM((1, F), jnp.float32)],
+        interpret=_use_interpret(),
+    )(x)
+    return out[:E] if pad else out
+
+
+def prefix_sum(x: jnp.ndarray, impl: str = "auto") -> jnp.ndarray:
+    """float32 cumulative sum of ``x`` [E, F] along axis 0."""
+    impl = os.environ.get("DISTEGNN_PREFIX_IMPL", impl)
+    if impl == "auto":
+        impl = ("pallas" if jax.default_backend() == "tpu"
+                and x.shape[0] >= _MIN_PALLAS_ROWS else "xla")
+    if impl == "pallas":
+        return _prefix_pallas(x)
+    if impl == "xla":
+        return jnp.cumsum(x.astype(jnp.float32), axis=0)
+    raise ValueError(f"unknown prefix_sum impl {impl!r}")

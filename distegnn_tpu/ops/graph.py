@@ -225,15 +225,29 @@ def pad_graphs(
             edge_mask[b, :e] = 1.0
 
     if (not edge_block) and compute_pair and edges_sorted:
-        # plain-layout reverse-edge involution over the PADDED lists (padding
-        # slots are (N-1, N-1) self-pairs); all-or-nothing across the batch so
-        # the pytree structure stays stable
+        # plain-layout reverse-edge involution. Computed on each graph's RAW
+        # edge list and cached on the graph dict (it is deterministic and
+        # index-stable — padding is appended after the real edges), so
+        # loaders that re-pad every epoch sort each edge list once, not once
+        # per epoch; padded tail slots are (N-1, N-1) self-pairs. All-or-
+        # nothing across the batch so the pytree structure stays stable.
         from distegnn_tpu.ops.blocked import pairing_perm_fast
 
-        pairs = [pairing_perm_fast(edge_index[b].astype(np.int64))
-                 for b in range(bsz)]
-        edge_pair = (np.stack(pairs).astype(np.int32)
-                     if all(p is not None for p in pairs) else None)
+        pairs = []
+        for g in graphs:
+            e = g["edge_index"].shape[1]
+            p = g.get("_plain_pair")
+            if p is None or p.shape[0] != e:
+                p = pairing_perm_fast(g["edge_index"].astype(np.int64))
+                if p is not None:
+                    g["_plain_pair"] = p
+            if p is None:
+                pairs = None
+                break
+            full = np.arange(E, dtype=np.int32)
+            full[:e] = p
+            pairs.append(full)
+        edge_pair = np.stack(pairs).astype(np.int32) if pairs is not None else None
 
     return GraphBatch(
         node_feat=node_feat, node_attr=node_attr, loc=loc, vel=vel, target=target,

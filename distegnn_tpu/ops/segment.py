@@ -113,7 +113,10 @@ def _cs_bounds(segment_ids, num_segments):
 
 
 def _cs_sum_impl(data, segment_ids, num_segments):
-    c = jnp.cumsum(data.astype(jnp.float32), axis=0)
+    from distegnn_tpu.ops.cumsum import prefix_sum
+
+    E = data.shape[0]
+    c = prefix_sum(data.reshape(E, -1)).reshape((E,) + data.shape[1:])
     starts, ends = _cs_bounds(segment_ids, num_segments)
     tail = (1,) * (data.ndim - 1)
     hi = jnp.where((ends > 0).reshape((-1,) + tail),
@@ -153,15 +156,22 @@ def segment_sum_cs(data, segment_ids, num_segments, mask=None):
 
 def segment_mean_cs(data, segment_ids, num_segments, mask=None):
     """Drop-in for :func:`segment_mean` on sorted ids, cumsum lowering
-    (counts clamped >= 1, reference models/FastEGNN.py:337)."""
-    total = segment_sum_cs(data, segment_ids, num_segments, mask=mask)
-    if mask is None:
-        ones = jnp.ones(data.shape[:1], jnp.float32)
+    (counts clamped >= 1, reference models/FastEGNN.py:337). The count rides
+    the same prefix pass as the data (one extra column), so a mean costs one
+    cumsum, not two."""
+    E = data.shape[0]
+    flat = data.reshape(E, -1)
+    if mask is not None:
+        m = mask.astype(flat.dtype).reshape(E, 1)
+        flat = flat * m
+        ones = m
     else:
-        ones = mask.astype(jnp.float32)
-    count = sorted_segment_sum_cs(ones, segment_ids, num_segments)
-    count = jnp.maximum(count, 1.0).astype(data.dtype)
-    return total / count.reshape((num_segments,) + (1,) * (data.ndim - 1))
+        ones = jnp.ones((E, 1), flat.dtype)
+    packed = sorted_segment_sum_cs(jnp.concatenate([flat, ones], axis=1),
+                                   segment_ids, num_segments)
+    total, count = packed[:, :-1], packed[:, -1:]
+    count = jnp.maximum(count.astype(jnp.float32), 1.0).astype(data.dtype)
+    return (total / count).reshape((num_segments,) + data.shape[1:])
 
 
 @jax.custom_vjp

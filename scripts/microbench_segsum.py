@@ -59,9 +59,16 @@ def main():
     dt = jnp.bfloat16 if bf16 else jnp.float32
     rng = np.random.default_rng(0)
 
-    # degree-realistic sorted ids (radius-graph degrees ~ Poisson(14.5))
+    # degree-realistic sorted ids (radius-graph degrees ~ Poisson(14.5));
+    # spread the sampling residual one edge per node so no single node's
+    # degree (and hence the ELL dmax/read-amp) is distorted
     deg = rng.poisson(E / N, size=N).astype(np.int64)
-    deg[0] += E - deg.sum()  # exact total
+    diff = E - deg.sum()
+    if diff:
+        idx = rng.choice(N, size=abs(diff), replace=abs(diff) > N)
+        np.add.at(deg, idx, 1 if diff > 0 else -1)
+        deg = np.maximum(deg, 0)
+        deg[0] += E - deg.sum()  # at most a few leftovers from the clamp
     ids_np = np.repeat(np.arange(N), deg).astype(np.int32)
     starts_np = np.zeros(N + 1, np.int64)
     np.cumsum(deg, out=starts_np[1:])
@@ -83,18 +90,25 @@ def main():
     ell_idx = jnp.asarray(ell_idx_np)
     ell_msk = jnp.asarray(ell_msk_np).astype(dt)
 
+    from distegnn_tpu.ops.cumsum import prefix_sum
+
     f_copy = jax.jit(lambda d: d * 1.0001)
     f_gather = jax.jit(lambda d, i: d[i])
     f_scatter = jax.jit(lambda d, i: jnp.zeros((N, H), d.dtype).at[i].add(
         d, indices_are_sorted=True))
+    # the prefix pass in isolation, both lowerings (ops/cumsum.py): XLA emits
+    # O(log E) shifted-add passes, the Pallas kernel a single sequential pass
+    f_prefix_xla = jax.jit(lambda d: prefix_sum(d, impl="xla"))
+    f_prefix_pl = jax.jit(lambda d: prefix_sum(d, impl="pallas"))
 
-    def cumsum_diff(d, s, e):
-        c = jnp.cumsum(d.astype(jnp.float32), axis=0)  # f32 accum even for bf16 data
+    def cumsum_diff(d, s, e, impl="auto"):
+        c = prefix_sum(d, impl=impl)
         hi = c[e - 1]
         lo = jnp.where((s > 0)[:, None], c[jnp.maximum(s - 1, 0)], 0.0)
         return (hi - lo).astype(d.dtype)
 
-    f_cumsum = jax.jit(cumsum_diff)
+    f_cumsum = jax.jit(lambda d, s, e: cumsum_diff(d, s, e, "xla"))
+    f_cumsum_pl = jax.jit(lambda d, s, e: cumsum_diff(d, s, e, "pallas"))
 
     def ell_sum(d, idx, msk):
         return (d[idx] * msk[..., None]).sum(axis=1)
@@ -118,7 +132,10 @@ def main():
     print(f"copy_[E,{H}]       {timed(f_copy, x):8.2f} ms")
     print(f"gather_rows        {timed(f_gather, xn, ids):8.2f} ms")
     print(f"scatter_sorted     {timed(f_scatter, x, ids):8.2f} ms")
-    print(f"cumsum_diff        {timed(f_cumsum, x, starts, ends):8.2f} ms")
+    print(f"prefix_xla         {timed(f_prefix_xla, x):8.2f} ms")
+    print(f"prefix_pallas      {timed(f_prefix_pl, x):8.2f} ms")
+    print(f"cumsum_diff_xla    {timed(f_cumsum, x, starts, ends):8.2f} ms")
+    print(f"cumsum_diff_pallas {timed(f_cumsum_pl, x, starts, ends):8.2f} ms")
     print(f"ell_gather_sum     {timed(f_ell, x, ell_idx, ell_msk):8.2f} ms")
     print(f"vjp_scatter        {timed(g_scatter, x):8.2f} ms")
     print(f"vjp_cumsum         {timed(g_cumsum, x):8.2f} ms")

@@ -180,3 +180,30 @@ def test_fastegnn_cumsum_without_pair(rng):
     out_sc = FastEGNN(**kw).apply(params, g)
     out_cs = FastEGNN(**kw, segment_impl="cumsum").apply(params, g)
     np.testing.assert_allclose(out_cs[0], out_sc[0], atol=5e-5)
+
+
+def test_prefix_sum_pallas_matches_xla(rng):
+    """ops/cumsum.py: the sequential Pallas kernel (interpret mode on CPU)
+    equals XLA's cumsum, including the tile-boundary carry and ragged tail."""
+    from distegnn_tpu.ops.cumsum import _TILE, prefix_sum, _prefix_pallas
+
+    for rows in (5, _TILE, _TILE + 7, 3 * _TILE - 1):
+        x = jnp.asarray(rng.standard_normal((rows, 3)).astype(np.float32))
+        np.testing.assert_allclose(_prefix_pallas(x, tile=min(rows, 64)),
+                                   prefix_sum(x, impl="xla"),
+                                   rtol=1e-5, atol=1e-4)
+    # bf16 input accumulates in f32
+    xb = jnp.asarray(rng.standard_normal((100, 2)).astype(np.float32)).astype(
+        jnp.bfloat16)
+    out = _prefix_pallas(xb, tile=32)
+    assert out.dtype == jnp.float32
+    np.testing.assert_allclose(out, prefix_sum(xb, impl="xla"), rtol=2e-2, atol=1e-1)
+
+
+def test_prefix_sum_pallas_under_vmap(rng):
+    from distegnn_tpu.ops.cumsum import _prefix_pallas, prefix_sum
+
+    x = jnp.asarray(rng.standard_normal((4, 130, 3)).astype(np.float32))
+    out = jax.vmap(lambda xx: _prefix_pallas(xx, tile=64))(x)
+    ref = jax.vmap(lambda xx: prefix_sum(xx, impl="xla"))(x)
+    np.testing.assert_allclose(out, ref, rtol=1e-5, atol=1e-4)

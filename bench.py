@@ -220,8 +220,17 @@ def main():
     if best is not None:
         print(json.dumps(best))
     else:
-        # last resort: measure plain in-process
-        print(json.dumps(measure(0)))
+        # Both children failed — almost certainly unreachable hardware (a
+        # wedged axon tunnel). Do NOT fall back to an in-process measurement:
+        # on a wedged tunnel that blocks forever at the first device op, and
+        # a hung bench records nothing at all. Emit an honest failure line.
+        print(json.dumps({
+            "metric": "largefluid_train_nodes_per_sec_per_chip",
+            "value": 0.0,
+            "unit": f"MEASUREMENT FAILED (both bench children died; "
+                    f"likely wedged TPU tunnel): {'; '.join(fails)[:300]}",
+            "vs_baseline": 0.0,
+        }))
 
 
 if __name__ == "__main__":

@@ -11,12 +11,14 @@ Layouts (docs/PERFORMANCE.md):
   plain        — row-sorted padded edge list, XLA scatter/gather aggregation
   plain-cumsum — same layout, --seg cumsum: scatter-free prefix-sum
                  aggregations with gather-only VJPs (ops/segment.py)
+  plain-ell    — same layout, --seg ell: scatter-free fixed-degree chained
+                 gathers, exact arithmetic (ops/segment.py ELL block)
   blocked      — blocked-CSR layout, one-hot contraction ops (ops/blocked.py;
                  --impl einsum|pallas selects the lowering); hardware-measured
                  slower than plain, kept for explicit runs only
-Default is auto: measure plain-cumsum AND plain-scatter, each in a child
-process (so a compiler surprise on new hardware cannot take down the bench),
-and report the faster real measurement.
+Default is auto: measure plain-cumsum, plain-ell AND plain-scatter, each
+in a child process (so a compiler surprise on new hardware cannot take down
+the bench), and report the faster real measurement.
 
 Timing methodology (v2, round 2 — see BASELINE.md "Measurement integrity"):
 round 1 timed a donated jit with jax.block_until_ready, which RETURNS EARLY
@@ -99,7 +101,7 @@ def measure(edge_block: int, impl: str = "einsum", seg: str = "scatter"):
     from distegnn_tpu.train import TrainState, make_optimizer, make_train_step
 
     rng = np.random.default_rng(0)
-    batch, n_edges = make_fluid_batch(rng, edge_block, pairing=(seg == "cumsum"))
+    batch, n_edges = make_fluid_batch(rng, edge_block, pairing=(seg in ("cumsum", "ell")))
 
     model = FastEGNN(node_feat_nf=3, node_attr_nf=2, edge_attr_nf=2,
                      hidden_nf=HIDDEN, virtual_channels=CHANNELS, n_layers=LAYERS,
@@ -159,7 +161,7 @@ def main():
     args = sys.argv[1:]
     layout, impl, seg = "auto", "einsum", "scatter"
     usage = ("usage: bench.py [--layout plain|blocked|auto] "
-             "[--impl pallas|einsum] [--seg scatter|cumsum]")
+             "[--impl pallas|einsum] [--seg scatter|cumsum|ell]")
     if "--layout" in args:
         i = args.index("--layout")
         if i + 1 >= len(args) or args[i + 1] not in ("plain", "blocked", "auto"):
@@ -172,7 +174,7 @@ def main():
         impl = args[i + 1]
     if "--seg" in args:
         i = args.index("--seg")
-        if i + 1 >= len(args) or args[i + 1] not in ("scatter", "cumsum"):
+        if i + 1 >= len(args) or args[i + 1] not in ("scatter", "cumsum", "ell"):
             sys.exit(usage)
         seg = args[i + 1]
 
@@ -192,6 +194,7 @@ def main():
     # blocked if revisiting.
     best, fails = None, []
     for child_args in (["--layout", "plain", "--seg", "cumsum"],
+                       ["--layout", "plain", "--seg", "ell"],
                        ["--layout", "plain"]):
         try:
             out = subprocess.run(

@@ -79,9 +79,10 @@ _DEFAULTS: dict = {
         # changes those models' param trees (checkpoints are incompatible
         # across the flag; restore fails with a clear error)
         "hoist_edge_mlp": True,
-        # plain-layout aggregation lowering ('scatter' = XLA sorted scatter,
-        # bit-exact; 'cumsum' = scatter-free prefix-sum differences with
-        # gather-only VJPs — see ops/segment.py). Fast* families only.
+        # plain-layout aggregation lowering (see ops/segment.py; Fast*
+        # families only): 'scatter' = XLA sorted scatter (bit-exact),
+        # 'cumsum' = scatter-free prefix-sum differences (f32-rounded),
+        # 'ell' = scatter-free fixed-degree gathers (exact).
         "segment_impl": "scatter",
     },
     "data": {

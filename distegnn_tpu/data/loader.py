@@ -63,6 +63,7 @@ class GraphLoader:
         edge_tile: int = 512,
         pairing: Optional[bool] = None,  # None=auto (blocked: symmetry scan; plain: off)
         cache_bytes: int = 2 << 30,
+        max_in_degree: Optional[int] = None,  # plain+pairing: dataset-stable ELL D
     ):
         self.dataset = dataset
         self.batch_size = batch_size
@@ -116,6 +117,16 @@ class GraphLoader:
                 max_nodes = max_nodes if max_nodes is not None else _round_up(n, node_bucket)
                 max_edges = max_edges if max_edges is not None else _round_up(e, edge_bucket)
             self.max_nodes, self.max_edges = max_nodes, max_edges
+            # GraphBatch.max_in_degree is STATIC: a per-batch value would
+            # retrace the jitted step whenever it crossed a bucket boundary,
+            # so scan the dataset once for a run-stable D (same rationale as
+            # the blocked path's edges_per_block scan above)
+            if self.pairing and max_in_degree is None:
+                deg = max(int(np.bincount(dataset[i]["edge_index"][0],
+                                          minlength=1).max())
+                          for i in range(len(dataset)))
+                max_in_degree = _round_up(max(deg, 1), 8)
+            self.max_in_degree = max_in_degree
         if len(self) == 0:
             raise ValueError(
                 f"batch_size {batch_size} > dataset size {len(dataset)}: "
@@ -129,7 +140,7 @@ class GraphLoader:
                         edges_per_block=self.edges_per_block,
                         max_nodes=self.max_nodes, compute_pair=self.pairing)
         return dict(max_nodes=self.max_nodes, max_edges=self.max_edges,
-                    compute_pair=self.pairing)
+                    compute_pair=self.pairing, max_in_degree=self.max_in_degree)
 
     def _graph(self, i: int) -> dict:
         """Fetch graph i, blockified (and cached) when edge_block is on."""
@@ -219,11 +230,18 @@ class ShardedGraphLoader:
                 for d in datasets
             ]
         else:
+            # one static max_in_degree across ALL shards so the stacked
+            # [P, B, ...] batches share a single pytree identity
+            mid = None
+            if pairing:
+                deg = max(int(np.bincount(d[i]["edge_index"][0], minlength=1).max())
+                          for d in datasets for i in range(len(d)))
+                mid = _round_up(max(deg, 1), 8)
             self.loaders = [
                 GraphLoader(
                     d, batch_size * data_parallel, shuffle=shuffle, seed=seed,
                     max_nodes=_round_up(n, node_bucket), max_edges=_round_up(e, edge_bucket),
-                    pairing=pairing,
+                    pairing=pairing, max_in_degree=mid,
                 )
                 for d in datasets
             ]

@@ -60,7 +60,7 @@ class EGCLVel(nn.Module):
     # math, E/N x fewer matmul rows, no [E, 2H+S] concat. False restores the
     # reference-shaped concat MLP (different param tree — not ckpt-compatible)
     hoist_edge_mlp: bool = True
-    seg_impl: str = "scatter"  # plain-layout aggregation lowering ('scatter'|'cumsum')
+    seg_impl: str = "scatter"  # plain-layout aggregation lowering ('scatter'|'cumsum'|'ell')
 
     @nn.compact
     def __call__(
@@ -203,10 +203,10 @@ class FastEGNN(nn.Module):
     # forward, ops are batched dots (default — no Pallas grid overhead);
     # 'pallas' = one-hot built in VMEM per kernel
     blocked_impl: str = "einsum"
-    # plain-layout aggregation lowering: 'scatter' (XLA sorted scatter,
-    # bit-exact) or 'cumsum' (scatter-free prefix-sum differences with
-    # gather-only VJPs, ops/segment.py — f32-accumulated, so sums carry
-    # ~|prefix|*eps rounding; pair with compute_dtype='bf16')
+    # plain-layout aggregation lowering (ops/segment.py): 'scatter' (XLA
+    # sorted scatter, bit-exact), 'cumsum' (scatter-free prefix-sum
+    # differences — f32-accumulated, sums carry ~|prefix|*eps rounding), or
+    # 'ell' (scatter-free fixed-degree gathers — exact)
     segment_impl: str = "scatter"
     # recompute each layer's activations in the backward pass instead of
     # keeping them in HBM: layer activations are O(E*H) (hundreds of MB at

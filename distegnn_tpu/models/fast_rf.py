@@ -120,7 +120,7 @@ class FastRF(nn.Module):
     n_layers: int = 4
     axis_name: Optional[str] = None
     blocked_impl: str = "einsum"  # blocked-layout edge-op lowering ('pallas'|'einsum')
-    segment_impl: str = "scatter"  # plain-layout lowering ('scatter'|'cumsum')
+    segment_impl: str = "scatter"  # plain-layout lowering ('scatter'|'cumsum'|'ell')
 
     @nn.compact
     def __call__(self, g: GraphBatch) -> Tuple[jnp.ndarray, jnp.ndarray]:

@@ -167,7 +167,7 @@ class FastSchNet(nn.Module):
     axis_name: Optional[str] = None
     blocked_impl: str = "einsum"  # blocked-layout edge-op lowering ('pallas'|'einsum')
     hoist_edge_mlp: bool = True   # phi_e + gate first Dense on the node axis
-    segment_impl: str = "scatter"  # plain-layout lowering ('scatter'|'cumsum')
+    segment_impl: str = "scatter"  # plain-layout lowering ('scatter'|'cumsum'|'ell')
 
     @nn.compact
     def __call__(self, g: GraphBatch) -> Tuple[jnp.ndarray, jnp.ndarray]:

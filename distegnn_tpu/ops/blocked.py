@@ -528,6 +528,9 @@ class EdgeOps:
       cumsum    ``seg_impl='cumsum'`` on a plain row-sorted batch: prefix-sum
                 differences with gather-only custom VJPs — no XLA scatter in
                 forward OR backward (ops/segment.py cumsum block);
+      ell       ``seg_impl='ell'`` on a plain row-sorted batch carrying
+                max_in_degree: fixed-degree chained gathers — scatter-free
+                AND exact (ops/segment.py ELL block);
       scatter   XLA sorted-scatter otherwise (bit-exact reference path).
 
     ``slot``/``inv_deg``/``oh`` come from :func:`blocked_slot_inv_deg`
@@ -539,12 +542,15 @@ class EdgeOps:
                  seg_impl: str = "scatter"):
         self.g, self.slot, self.inv_deg, self.oh = g, slot, inv_deg, oh
         self.blocked = slot is not None
-        if seg_impl not in ("scatter", "cumsum"):
+        if seg_impl not in ("scatter", "cumsum", "ell"):
             raise ValueError(f"unknown seg_impl {seg_impl!r}")
-        # the cumsum lowering needs ascending row ids; keep the exact scatter
-        # path when the batch can't support it
+        # both scatter-free lowerings need ascending row ids (ELL also the
+        # static max_in_degree); keep the exact scatter path when the batch
+        # can't support the request
         self.cumsum = (seg_impl == "cumsum" and not self.blocked
                        and g.edges_sorted)
+        self.ell = (seg_impl == "ell" and not self.blocked
+                    and g.edges_sorted and g.max_in_degree > 0)
 
     def gather_rows(self, data):
         if self.blocked:
@@ -557,6 +563,11 @@ class EdgeOps:
             from distegnn_tpu.ops.segment import gather_rows_cs
 
             return jax.vmap(gather_rows_cs)(data, self.g.row)
+        if self.ell:
+            from distegnn_tpu.ops.segment import gather_rows_ell
+
+            D = self.g.max_in_degree
+            return jax.vmap(lambda h, r: gather_rows_ell(h, r, D))(data, self.g.row)
         return jnp.take_along_axis(data, self.g.row[..., None], axis=1)
 
     def gather_cols(self, data):
@@ -572,6 +583,12 @@ class EdgeOps:
 
             return jax.vmap(paired_gather_cols_cs)(data, g.col, g.edge_pair,
                                                    g.row, g.edge_mask)
+        if self.ell and g.edge_pair is not None:
+            from distegnn_tpu.ops.segment import paired_gather_cols_ell
+
+            D = g.max_in_degree
+            return jax.vmap(lambda h, c, p, r, m: paired_gather_cols_ell(
+                h, c, p, r, m, D))(data, g.col, g.edge_pair, g.row, g.edge_mask)
         return jnp.take_along_axis(data, g.col[..., None], axis=1)
 
     def _agg(self, data, mean: bool):
@@ -592,6 +609,14 @@ class EdgeOps:
         if self.cumsum:
             seg_cs = segment_mean_cs if mean else segment_sum_cs
             return jax.vmap(lambda t, r, m: seg_cs(t, r, N, mask=m))(
+                data, g.row, g.edge_mask)
+        if self.ell:
+            from distegnn_tpu.ops.segment import (segment_mean_ell,
+                                                  segment_sum_ell)
+
+            seg_el = segment_mean_ell if mean else segment_sum_ell
+            D = g.max_in_degree
+            return jax.vmap(lambda t, r, m: seg_el(t, r, N, D, mask=m))(
                 data, g.row, g.edge_mask)
         seg = segment_mean if mean else segment_sum
         return jax.vmap(lambda t, r, m: seg(

@@ -67,6 +67,11 @@ class GraphBatch:
     edges_sorted: bool = struct.field(pytree_node=False, default=False)
     edge_block: int = struct.field(pytree_node=False, default=0)
     edge_tile: int = struct.field(pytree_node=False, default=0)
+    # max REAL in-degree over the batch, rounded up to 8 (0 = not computed).
+    # Static: enables the ELL aggregation lowering (segment_impl='ell',
+    # ops/segment.py). Computed together with the plain pairing
+    # (compute_pair=True) so scatter-only workflows keep one pytree identity.
+    max_in_degree: int = struct.field(pytree_node=False, default=0)
 
     @property
     def batch_size(self) -> int:
@@ -115,6 +120,7 @@ def pad_graphs(
     edges_per_block: Optional[int] = None,
     edge_tile: int = 512,
     compute_pair: Optional[bool] = None,
+    max_in_degree: Optional[int] = None,
 ) -> "GraphBatch":
     """Pack a list of per-graph numpy dicts into one padded GraphBatch.
 
@@ -224,7 +230,21 @@ def pad_graphs(
         else:
             edge_mask[b, :e] = 1.0
 
-    if (not edge_block) and compute_pair and edges_sorted:
+    if not ((not edge_block) and compute_pair and edges_sorted):
+        max_in_degree = 0
+    else:
+        # the static D of the ELL lowering (rounded to 8 so nearby batches
+        # share a compiled program). Loaders pass a DATASET-stable value,
+        # since a static field that varies across batches retraces the jitted
+        # step (same concern as edges_per_block for the blocked layout);
+        # an undersized value would silently drop edges, so it is validated.
+        deg = max(int(np.bincount(g["edge_index"][0], minlength=1).max())
+                  for g in graphs)
+        if max_in_degree is None:
+            max_in_degree = -(-max(deg, 1) // 8) * 8
+        elif max_in_degree < deg:
+            raise ValueError(f"pad_graphs: max_in_degree {max_in_degree} < "
+                             f"actual batch max in-degree {deg}")
         # plain-layout reverse-edge involution. Computed on each graph's RAW
         # edge list and cached on the graph dict (it is deterministic and
         # index-stable — padding is appended after the real edges), so
@@ -254,7 +274,7 @@ def pad_graphs(
         loc_mean=loc_mean, node_mask=node_mask, edge_index=edge_index,
         edge_attr=edge_attr, edge_mask=edge_mask, edges_sorted=edges_sorted,
         edge_block=edge_block, edge_tile=edge_tile if edge_block else 0,
-        edge_pair=edge_pair,
+        edge_pair=edge_pair, max_in_degree=max_in_degree,
     )
 
 

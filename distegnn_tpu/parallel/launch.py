@@ -226,7 +226,7 @@ def run_distributed(config):
             # cumsum aggregation wants the reverse-edge pairing attached to
             # plain batches (scatter-free col-gather backward, ops/segment.py)
             pairing=(True if (not d.edge_block and
-                              config.model.get("segment_impl") == "cumsum")
+                              config.model.get("segment_impl") in ("cumsum", "ell"))
                      else None),
         ), put))
     loader_train, loader_valid, loader_test = loaders

@@ -127,7 +127,7 @@ def main(argv=None):
         # cumsum aggregation wants the reverse-edge pairing for scatter-free
         # col-gather backwards (plain layout; ops/segment.py)
         pairing=(True if (not config.data.edge_block and
-                          config.model.get("segment_impl") == "cumsum") else None),
+                          config.model.get("segment_impl") in ("cumsum", "ell")) else None),
     )
     loader_train, loader_valid, loader_test = mk(ds_train, True), mk(ds_valid, False), mk(ds_test, False)
 

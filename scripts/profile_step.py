@@ -66,7 +66,7 @@ def main():
                     help="blocked edge layout (0 = plain)")
     ap.add_argument("--impl", default="einsum", choices=["einsum", "pallas"],
                     help="blocked-op lowering (with --edge-block)")
-    ap.add_argument("--seg", default="scatter", choices=["scatter", "cumsum"],
+    ap.add_argument("--seg", default="scatter", choices=["scatter", "cumsum", "ell"],
                     help="plain-layout aggregation lowering")
     args = ap.parse_args()
 
@@ -82,7 +82,7 @@ def main():
 
     rng = np.random.default_rng(0)
     batch, n_edges = make_fluid_batch(rng, edge_block=args.edge_block,
-                                      pairing=(args.seg == "cumsum"))
+                                      pairing=(args.seg in ("cumsum", "ell")))
     dev = jax.devices()[0]
     batch = jax.device_put(batch, dev)
 

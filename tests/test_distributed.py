@@ -252,3 +252,9 @@ def test_distributed_cumsum_matches_scatter(dist_setup):
     loc_cs, X_cs = fwd_of(model_P.copy(segment_impl="cumsum"))(params, stacked)
     np.testing.assert_allclose(np.asarray(X_cs), np.asarray(X_sc), atol=1e-4)
     np.testing.assert_allclose(np.asarray(loc_cs), np.asarray(loc_sc), atol=1e-4)
+
+    # the ELL lowering rides the same pairing + static max_in_degree
+    assert stacked.max_in_degree > 0
+    loc_el, X_el = fwd_of(model_P.copy(segment_impl="ell"))(params, stacked)
+    np.testing.assert_allclose(np.asarray(X_el), np.asarray(X_sc), atol=1e-5)
+    np.testing.assert_allclose(np.asarray(loc_el), np.asarray(loc_sc), atol=1e-5)

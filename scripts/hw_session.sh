@@ -26,22 +26,39 @@ cd "$(dirname "$0")/.."
 LOG=${1:-/tmp/hw_session.log}
 
 probe() {
-  timeout 60 python -c "
+  timeout 90 python -c "
 import jax, jax.numpy as jnp
 print('probe ok', float((jnp.ones((256,256))@jnp.ones((256,256))).sum()))" \
     >>"$LOG" 2>&1
 }
 
 echo "=== hw_session $(date -u +%FT%TZ) ===" >>"$LOG"
-if ! probe; then
+# The tunnel releases a client's claim slowly: a probe immediately after
+# another client exits can hang even when the tunnel is healthy (observed
+# twice 2026-07-30: manual probe ok, script probe 25 s later 'wedged').
+# Retry a few times with spacing before giving up.
+ok=""
+for attempt in 1 2 3; do
+  if probe; then ok=1; break; fi
+  echo "probe attempt $attempt failed; retrying in 150s" >>"$LOG"
+  sleep 150
+done
+if [ -z "$ok" ]; then
   echo "TPU wedged; aborting" >>"$LOG"
   exit 2
 fi
 
 GEN_PIDS=$(pgrep -f "generate_nbody_chunked" || true)
-resume() { [ -n "$GEN_PIDS" ] && kill -CONT $GEN_PIDS 2>/dev/null; }
+# pytest contends for the single host core too (a concurrent suite degraded
+# step timing ~4x — BASELINE.md); pause it for the measurement window
+PYTEST_PIDS=$(pgrep -f "pytest" || true)
+resume() {
+  [ -n "$GEN_PIDS" ] && kill -CONT $GEN_PIDS 2>/dev/null
+  [ -n "$PYTEST_PIDS" ] && kill -CONT $PYTEST_PIDS 2>/dev/null
+}
 trap resume EXIT
 [ -n "$GEN_PIDS" ] && kill -STOP $GEN_PIDS 2>/dev/null
+[ -n "$PYTEST_PIDS" ] && kill -STOP $PYTEST_PIDS 2>/dev/null
 
 run() {  # run <label> <cmd...> — NO kill timeout (see header)
   local label=$1; shift

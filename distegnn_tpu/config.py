@@ -137,6 +137,11 @@ _DEFAULTS: dict = {
         # run parallel/checks.assert_replicated on eval epochs (the reference's
         # startup broadcast+allclose rank check, made continuous)
         "check_consistency": True,
+        # capture a jax.profiler trace of this epoch (0 = off) into
+        # <exp_dir>/trace/ — open with TensorBoard/Perfetto/xprof. The
+        # reference's profiling story is a no-op shim (SURVEY.md §5.1); here
+        # it is a first-class flag on the training surface.
+        "trace_epoch": 0,
         "wandb": {"enable": False, "offline": True, "api_key": "", "project": "", "entity": ""},
     },
 }

@@ -101,11 +101,22 @@ def train(
 
     for epoch in range(1 + start_epoch, train_cfg.epochs + 1):
         t_epoch = time.perf_counter()
+        # optional device trace of exactly one epoch (log.trace_epoch):
+        # SURVEY §5.1 observability — the per-op timeline behind the
+        # epoch_time numbers, viewable in TensorBoard/Perfetto
+        tracing = is_main and log and log_cfg.get("trace_epoch", 0) == epoch
+        if tracing:
+            trace_dir = os.path.join(exp_dir, "trace")
+            os.makedirs(trace_dir, exist_ok=True)
+            jax.profiler.start_trace(trace_dir)
         if scan_runner is not None:
             state, loss_train = scan_runner.train_epoch(state, epoch)
             loss_train = float(loss_train)
         else:
             state, loss_train = run_epoch_train(train_step, state, loader_train, seed, epoch)
+        if tracing:
+            jax.profiler.stop_trace()
+            print(f"profiler trace of epoch {epoch} written to {trace_dir}", flush=True)
         dt_epoch = time.perf_counter() - t_epoch
         log_dict["loss_train"].append(loss_train)
         # observability (SURVEY §5.1/§5.5): per-epoch wall time is recorded in

@@ -49,9 +49,11 @@ if [ -z "$ok" ]; then
 fi
 
 GEN_PIDS=$(pgrep -f "generate_nbody_chunked" || true)
-# pytest contends for the single host core too (a concurrent suite degraded
-# step timing ~4x — BASELINE.md); pause it for the measurement window
-PYTEST_PIDS=$(pgrep -f "pytest" || true)
+# pytest / a CPU training run contend for the single host core too (a
+# concurrent suite degraded step timing ~4x — BASELINE.md); pause them for
+# the measurement window. The snapshot is taken NOW, so this session's own
+# convergence run (started below) is never self-paused.
+PYTEST_PIDS=$(pgrep -f "pytest|main\.py --config_path" || true)
 resume() {
   [ -n "$GEN_PIDS" ] && kill -CONT $GEN_PIDS 2>/dev/null
   [ -n "$PYTEST_PIDS" ] && kill -CONT $PYTEST_PIDS 2>/dev/null

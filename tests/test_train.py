@@ -227,3 +227,29 @@ def test_checkpoint_rejects_mismatched_architecture(tmp_path, tiny_setup):
     fresh = TrainState.create(p2, tx)
     with pytest.raises(ValueError, match="checkpoint incompatible"):
         restore_checkpoint(path, fresh)
+
+
+def test_trace_epoch_writes_profile(tiny_setup, tmp_path):
+    """log.trace_epoch=N captures a jax.profiler trace of epoch N into
+    <exp_dir>/trace/ (SURVEY §5.1 observability at the training surface)."""
+    import os
+
+    from distegnn_tpu.config import ConfigDict
+    from distegnn_tpu.train.trainer import train
+
+    model, params, graphs = tiny_setup
+    tx = make_optimizer(1e-3)
+    state = TrainState.create(params, tx)
+    step = jax.jit(make_train_step(model, tx, mmd_weight=0.0, mmd_sigma=1.0, mmd_samples=1))
+    ev = jax.jit(make_eval_step(model))
+    loader = GraphLoader(GraphDataset(graphs), batch_size=4, shuffle=False, seed=0)
+    config = ConfigDict({
+        "seed": 0,
+        "train": {"epochs": 2, "early_stop": 10},
+        "log": {"test_interval": 10, "log_dir": str(tmp_path), "exp_name": "tr",
+                "trace_epoch": 2, "wandb": {"enable": False}},
+    })
+    train(state, step, ev, loader, loader, loader, config, log=True)
+    trace_dir = os.path.join(str(tmp_path), "tr", "trace")
+    files = [os.path.join(r, f) for r, _, fs in os.walk(trace_dir) for f in fs]
+    assert files, "no profiler trace written"

@@ -191,17 +191,22 @@ def segment_mean_cs(data, segment_ids, num_segments, mask=None):
 # D comes from GraphBatch.max_in_degree (static; pad_graphs computes it).
 # --------------------------------------------------------------------------
 
-def _ell_sum_impl(data, segment_ids, num_segments, max_in_degree):
+def _ell_sum_impl(data, segment_ids, num_segments, max_in_degree,
+                  degree_chunk: int = 8):
+    """Chunked over the degree axis: each chunk is ONE [N, K, F] gather +
+    masked reduce (K = degree_chunk), bounding both the HLO count (D/K ops
+    per aggregation instead of D) and the gathered intermediate (N*K*F)."""
     E = data.shape[0]
     starts, ends = _cs_bounds(segment_ids, num_segments)
     tail = (1,) * (data.ndim - 1)
     out = jnp.zeros((num_segments,) + data.shape[1:], jnp.float32)
-    for d in range(max_in_degree):
-        idx = starts + d
-        valid = (idx < ends).reshape((-1,) + tail)
-        out = out + jnp.where(valid,
-                              jnp.take(data, jnp.minimum(idx, E - 1), axis=0)
-                              .astype(jnp.float32), 0.0)
+    for d0 in range(0, max_in_degree, degree_chunk):
+        k = min(degree_chunk, max_in_degree - d0)
+        idx = starts[:, None] + jnp.arange(d0, d0 + k)          # [N, K]
+        valid = (idx < ends[:, None]).reshape((-1, k) + tail)
+        blk = jnp.take(data, jnp.minimum(idx, E - 1).reshape(-1), axis=0)
+        blk = blk.reshape((num_segments, k) + data.shape[1:]).astype(jnp.float32)
+        out = out + jnp.where(valid, blk, 0.0).sum(axis=1)
     return out.astype(data.dtype)
 
 

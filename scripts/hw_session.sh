@@ -40,8 +40,8 @@ echo "=== hw_session $(date -u +%FT%TZ) ===" >>"$LOG"
 ok=""
 for attempt in 1 2 3; do
   if probe; then ok=1; break; fi
-  echo "probe attempt $attempt failed; retrying in 150s" >>"$LOG"
-  sleep 150
+  echo "probe attempt $attempt failed" >>"$LOG"
+  [ "$attempt" -lt 3 ] && sleep 150
 done
 if [ -z "$ok" ]; then
   echo "TPU wedged; aborting" >>"$LOG"

@@ -64,9 +64,20 @@ def save_checkpoint(path: str, state, epoch: int, losses: Optional[dict] = None,
     os.replace(tmp, path)  # atomic: a crash never leaves a torn checkpoint
 
 
+def _with_config_hint(payload, e: ValueError) -> ValueError:
+    saved_cfg = payload.get("config") or {}
+    model_cfg = saved_cfg.get("model") if isinstance(saved_cfg, dict) else None
+    hint = (f"; the checkpoint was written with model config {model_cfg}"
+            if model_cfg else "")
+    return ValueError(f"{e}{hint}")
+
+
 def restore_checkpoint(path: str, state) -> tuple[Any, int, dict]:
     """Restore into the structure of ``state`` (a freshly-created TrainState).
-    Returns (state, start_epoch, losses)."""
+    Returns (state, start_epoch, losses). The optimizer configuration must
+    match the one the checkpoint was written with (grad-accumulation wrapping
+    changes the opt-state tree); evaluation-only consumers should use
+    :func:`restore_params` instead."""
     with open(path, "rb") as f:
         payload = pickle.load(f)
     from distegnn_tpu.train.step import TrainState
@@ -78,9 +89,17 @@ def restore_checkpoint(path: str, state) -> tuple[Any, int, dict]:
             step=np.int32(payload["step"]),
         )
     except ValueError as e:
-        saved_cfg = payload.get("config") or {}
-        model_cfg = saved_cfg.get("model") if isinstance(saved_cfg, dict) else None
-        hint = (f"; the checkpoint was written with model config {model_cfg}"
-                if model_cfg else "")
-        raise ValueError(f"{e}{hint}") from None
+        raise _with_config_hint(payload, e) from None
     return restored, payload["epoch"], payload.get("losses", {})
+
+
+def restore_params(path: str, params) -> Any:
+    """Params-only restore for evaluation/rollout: ignores the saved
+    optimizer state, so a checkpoint written with ANY optimizer wrapping
+    (grad accumulation, schedules) loads into a bare model."""
+    with open(path, "rb") as f:
+        payload = pickle.load(f)
+    try:
+        return _from_leaves(params, payload["params_leaves"])
+    except ValueError as e:
+        raise _with_config_hint(payload, e) from None

@@ -349,13 +349,11 @@ def _init_params(model, checkpoint, config, seed):
     }
     params = model.init(jax.random.PRNGKey(seed), pad_graphs([g]))
     if checkpoint:
-        from distegnn_tpu.train import TrainState, make_optimizer
-        from distegnn_tpu.train.checkpoint import restore_checkpoint
+        # params-only: evaluation must load checkpoints written with ANY
+        # optimizer wrapping (grad accumulation changes the opt-state tree)
+        from distegnn_tpu.train.checkpoint import restore_params
 
-        tx = make_optimizer(1e-3)
-        state = TrainState.create(params, tx)
-        state, _, _ = restore_checkpoint(checkpoint, state)
-        params = state.params
+        params = restore_params(checkpoint, params)
     return params
 
 
@@ -406,7 +404,9 @@ def main(argv=None):
         "samples": num,
         "steps": steps,
         "checkpoint": args.checkpoint,
-        "horizons": {str(k): round(v, 6) for k, v in horizons.items()},
+        # significant figures, not fixed decimals: fluid displacement targets
+        # give MSEs of 1e-9 scale, which round(_, 6) flattened to 0.0
+        "horizons": {str(k): float(f"{v:.4g}") for k, v in horizons.items()},
     }))
 
 

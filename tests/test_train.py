@@ -253,3 +253,26 @@ def test_trace_epoch_writes_profile(tiny_setup, tmp_path):
     trace_dir = os.path.join(str(tmp_path), "tr", "trace")
     files = [os.path.join(r, f) for r, _, fs in os.walk(trace_dir) for f in fs]
     assert files, "no profiler trace written"
+
+
+def test_restore_params_ignores_optimizer_wrapping(tmp_path, tiny_setup):
+    """A checkpoint written with grad-accumulation (MultiSteps wraps extra
+    opt-state arrays) must load into a bare model for evaluation/rollout —
+    restore_params is params-only (restore_checkpoint correctly refuses)."""
+    from distegnn_tpu.train.checkpoint import (restore_checkpoint,
+                                               restore_params,
+                                               save_checkpoint)
+
+    model, params, graphs = tiny_setup
+    tx_acc = make_optimizer(1e-3, accumulation_steps=4)
+    state = TrainState.create(params, tx_acc)
+    path = str(tmp_path / "acc.ckpt")
+    save_checkpoint(path, state, epoch=3, config={"model": {"x": 1}})
+
+    restored = restore_params(path, params)
+    for a, b in zip(jax.tree.leaves(restored), jax.tree.leaves(params)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+    plain_state = TrainState.create(params, make_optimizer(1e-3))
+    with pytest.raises(ValueError, match="incompatible"):
+        restore_checkpoint(path, plain_state)

@@ -9,14 +9,19 @@
 #
 # Usage: bash scripts/convergence_session.sh [epochs]   (default: full 2500)
 
-set -eu
+# pipefail: main.py is piped through tee below — without it a training crash
+# is masked by tee's rc=0 and stale artifacts would be copied as evidence.
+set -euo pipefail
 cd "$(dirname "$0")/.."
 EPOCHS=${1:-2500}
 
-timeout 60 python -c "
-import jax, jax.numpy as jnp
-print('probe ok', float((jnp.ones((256,256))@jnp.ones((256,256))).sum()))" \
-  || { echo "TPU wedged; aborting (do not run this on CPU)"; exit 2; }
+# Probe unless the caller (hw_session run()) already probe-gated this item —
+# its probe cycle is up to ~9.5 min and a second one wastes the window.
+if [ -z "${CALLER_PROBED:-}" ]; then
+  bash scripts/tpu_probe.sh /dev/stdout \
+    || { echo "TPU wedged; aborting (do not run this on CPU)"; exit 2; }
+  sleep 30  # let the probe client's claim release before main.py acquires
+fi
 
 test -f data/n_body_system/nbody_100/loc_train_charged100_0_0_1.npy \
   || { echo "dataset missing; run scripts/generate_nbody_chunked.py first"; exit 3; }

@@ -1,0 +1,34 @@
+#!/usr/bin/env bash
+# Shared TPU-tunnel liveness probe (single source of the probe contract).
+#
+# Safety property: the probe client either completes a real round-trip
+# (matmul + host fetch) or never acquires the device — and a never-acquired
+# client is safe to timeout-kill without stranding the remote claim
+# (BASELINE.md; killing a LIVE client wedges the tunnel for everyone).
+#
+# The tunnel releases a client's claim slowly: a probe fired immediately
+# after another client exits can hang even when the tunnel is healthy
+# (observed twice 2026-07-30). So retry ATTEMPTS times with SPACING seconds
+# between attempts before declaring the tunnel down.
+#
+# Usage: bash scripts/tpu_probe.sh [logfile]     exit 0 = up, 1 = down
+#        ATTEMPTS=1 bash scripts/tpu_probe.sh    single-shot (watcher mode)
+
+set -u
+LOG=${1:-/dev/null}
+ATTEMPTS=${ATTEMPTS:-3}
+SPACING=${SPACING:-150}
+
+try() {
+  timeout 90 python -c "
+import jax, jax.numpy as jnp
+print('probe ok', float((jnp.ones((256,256))@jnp.ones((256,256))).sum()))" \
+    >>"$LOG" 2>&1
+}
+
+for attempt in $(seq 1 "$ATTEMPTS"); do
+  try && exit 0
+  echo "probe attempt $attempt failed" >>"$LOG"
+  [ "$attempt" -lt "$ATTEMPTS" ] && sleep "$SPACING"
+done
+exit 1

@@ -23,7 +23,10 @@ if [ -z "${CALLER_PROBED:-}" ]; then
   sleep 30  # let the probe client's claim release before main.py acquires
 fi
 
-test -f data/n_body_system/nbody_100/loc_train_charged100_0_0_1.npy \
+# Dataset sentinel: overridable by the caller (hw_session exports the same
+# path) so the tag literal lives in one place per invocation chain.
+NBODY_DONE=${NBODY_DONE:-data/n_body_system/nbody_100/loc_train_charged100_0_0_1.npy}
+test -f "$NBODY_DONE" \
   || { echo "dataset missing; run scripts/generate_nbody_chunked.py first"; exit 3; }
 
 python -u main.py --config_path configs/nbody_fastegnn.yaml --epochs "$EPOCHS" \
@@ -35,8 +38,10 @@ mkdir -p docs/artifacts
 cp "$EXP/log.json" docs/artifacts/nbody_fastegnn_log.json
 CKPT="$EXP/state_dict/best_model.ckpt"
 if [ -f "$CKPT" ]; then
+  # temp + mv: a crash mid-eval must not truncate previously-good evidence
   python scripts/evaluate_rollout.py --config_path configs/nbody_fastegnn.yaml \
     --checkpoint "$CKPT" --samples 200 \
-    > docs/artifacts/nbody_rollout_mse.json
+    > /tmp/nbody_rollout_mse.json.tmp
+  mv /tmp/nbody_rollout_mse.json.tmp docs/artifacts/nbody_rollout_mse.json
 fi
 echo "artifacts written under docs/artifacts/ — record the best MSEs in BASELINE.md and commit"

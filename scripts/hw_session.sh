@@ -46,12 +46,24 @@ probe() {
 
 echo "=== hw_session $(date -u +%FT%TZ) ===" >>"$LOG"
 
-GEN_PIDS=$(pgrep -f "generate_nbody_chunked" || true)
-# pytest / a CPU training run contend for the single host core too (a
-# concurrent suite degraded step timing ~4x — BASELINE.md); pause them for
-# the measurement window. The snapshot is taken NOW, so this session's own
-# convergence run (started below) is never self-paused.
-PYTEST_PIDS=$(pgrep -f "pytest|main\.py --config_path" || true)
+# Contending host processes to pause during measurement (a concurrent suite
+# degraded step timing ~4x — BASELINE.md). CRITICAL: the agent-driver
+# process embeds the whole task prompt in its command line, which contains
+# the literal strings "pytest" and "main.py --config_path" — a bare pgrep -f
+# matches it and SIGSTOPs the driver itself (this froze the controlling
+# session for the entire 6 h hung queue on 2026-07-31). Filter to real
+# python invocations: argv[0] must be a python executable.
+pgrep_py() {  # pgrep -f, restricted to processes whose argv[0] is python
+  for p in $(pgrep -f "$1" || true); do
+    head -zc 200 "/proc/$p/cmdline" 2>/dev/null | tr '\0' ' ' \
+      | grep -Eq "^[^ ]*python[0-9.]* " && echo "$p"
+  done
+  true
+}
+GEN_PIDS=$(pgrep_py "generate_nbody_chunked")
+# The snapshot is taken NOW, so this session's own convergence run (started
+# below) is never self-paused.
+PYTEST_PIDS=$(pgrep_py "pytest|main\.py --config_path")
 resume() {
   [ -n "$GEN_PIDS" ] && kill -CONT $GEN_PIDS 2>/dev/null
   [ -n "$PYTEST_PIDS" ] && kill -CONT $PYTEST_PIDS 2>/dev/null
@@ -60,8 +72,10 @@ trap resume EXIT
 [ -n "$GEN_PIDS" ] && kill -STOP $GEN_PIDS 2>/dev/null
 [ -n "$PYTEST_PIDS" ] && kill -STOP $PYTEST_PIDS 2>/dev/null
 
+ITEMS=()
 run() {  # run <label> <cmd...> — NO kill timeout (see header)
   local label=$1; shift
+  ITEMS+=("$label")  # single source for the final completeness check
   if [ -f "$DONE_DIR/$label" ]; then
     echo "--- $label already done (marker $DONE_DIR/$label); skipping ---" >>"$LOG"
     return 0
@@ -102,7 +116,7 @@ EOF
 # it on a complete dataset would regenerate everything from scratch — guard
 # on the merged output instead. It also exits 0 on a PARTIAL pass, so
 # success is "merged train file exists", not the generator's rc.
-NBODY_DONE=data/n_body_system/nbody_100/loc_train_charged100_0_0_1.npy
+export NBODY_DONE=data/n_body_system/nbody_100/loc_train_charged100_0_0_1.npy
 nbody_gen_and_check() {
   if [ ! -f "$NBODY_DONE" ]; then
     python scripts/generate_nbody_chunked.py \
@@ -130,6 +144,9 @@ if [ -n "$GEN_PIDS" ]; then
   sleep 2
   GEN_PIDS=""
 fi
+# If the CPU generator already finished the dataset, seed the marker so the
+# item costs no probe + settle at all.
+[ -f "$NBODY_DONE" ] && touch "$DONE_DIR/nbody_gen_tpu"
 run nbody_gen_tpu nbody_gen_and_check
 run convergence env CALLER_PROBED=1 bash scripts/convergence_session.sh
 
@@ -143,8 +160,7 @@ run profile_plain python scripts/profile_step.py --bf16
 # fail (rc!=0, no marker) without aborting the queue, and the watcher exits
 # for good on rc=0, so propagate incompleteness.
 missing=0
-for item in bench_auto nbody_gen_tpu convergence \
-            microbench_segsum microbench_segsum_bf16 profile_cumsum profile_plain; do
+for item in "${ITEMS[@]}"; do
   [ -f "$DONE_DIR/$item" ] || { echo "incomplete: $item" >>"$LOG"; missing=$((missing + 1)); }
 done
 echo "=== hw_session done $(date -u +%FT%TZ), $missing item(s) incomplete ===" >>"$LOG"

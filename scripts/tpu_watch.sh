@@ -42,7 +42,10 @@ while :; do
     # Let the probe client's claim release before the queue's first item
     # probes (>25 s release observed; same convention as hw_session run()).
     sleep 30
-    bash scripts/hw_session.sh "$QUEUE_LOG"
+    # 9>&- : don't leak the watcher's lock fd into the queue and its
+    # long-lived children — a dead watcher could then never be replaced
+    # while the inherited fd held the lock.
+    bash scripts/hw_session.sh "$QUEUE_LOG" 9>&-
     rc=$?
     FIRES=$((FIRES + 1))
     echo "$(date -u +%FT%TZ) hw_session rc=$rc (fire $FIRES/$MAX_FIRES)"

@@ -29,19 +29,61 @@ NBODY_DONE=${NBODY_DONE:-data/n_body_system/nbody_100/loc_train_charged100_0_0_1
 test -f "$NBODY_DONE" \
   || { echo "dataset missing; run scripts/generate_nbody_chunked.py first"; exit 3; }
 
-python -u main.py --config_path configs/nbody_fastegnn.yaml --epochs "$EPOCHS" \
-  2>&1 | tee /tmp/convergence_run.log
+# Resume a previously aborted run (tunnel death mid-training) instead of
+# restarting: the trainer writes last_model.ckpt every test_interval epochs
+# and main.py --checkpoint restores state + start_epoch. The resumed run
+# logs to a fresh exp dir; its log.json covers the resumed span. A FINISHED
+# prior run (early-stopped, or full epoch budget: log.json = [best, log,
+# cfg], "early_stop" in best or len(loss_train) >= epochs) must NOT be
+# resumed — main.py would run zero epochs and never write log.json; capture
+# its artifacts directly instead (covers a crash between training and
+# artifact capture as well).
+run_finished() {  # run_finished <last_model.ckpt> <log.json> <epochs>
+  # The ckpt's stored epoch is authoritative (a resumed run's own log.json
+  # covers only the resumed span, so log length would under-count).
+  python - "$1" "$2" "$3" <<'EOF'
+import json, pickle, sys
+ckpt_epoch = pickle.load(open(sys.argv[1], "rb"))["epoch"]
+best = json.load(open(sys.argv[2]))[0]
+done = "early_stop" in best or ckpt_epoch >= int(sys.argv[3])
+raise SystemExit(0 if done else 1)
+EOF
+}
 
-# newest run dir under logs/nbody
-EXP=$(ls -dt logs/nbody/*/ | head -1)
+CKPT_ARGS=()
+RUN_TRAINING=1
+EXP=""
+LAST=$(ls -dt logs/nbody/*/state_dict/last_model.ckpt 2>/dev/null | head -1 || true)
+if [ -n "$LAST" ]; then
+  PREV_EXP=$(dirname "$(dirname "$LAST")")
+  if [ -f "$PREV_EXP/log/log.json" ] && run_finished "$LAST" "$PREV_EXP/log/log.json" "$EPOCHS"; then
+    echo "previous run $PREV_EXP already finished — capturing its artifacts"
+    RUN_TRAINING=0
+    EXP="$PREV_EXP/"
+  else
+    echo "resuming from $LAST"
+    CKPT_ARGS=(--checkpoint "$LAST")
+  fi
+fi
+
+if [ "$RUN_TRAINING" -eq 1 ]; then
+  python -u main.py --config_path configs/nbody_fastegnn.yaml --epochs "$EPOCHS" \
+    ${CKPT_ARGS[@]+"${CKPT_ARGS[@]}"} \
+    2>&1 | tee /tmp/convergence_run.log
+  # newest run dir under logs/nbody
+  EXP=$(ls -dt logs/nbody/*/ | head -1)
+fi
 mkdir -p docs/artifacts
-cp "$EXP/log.json" docs/artifacts/nbody_fastegnn_log.json
+# trainer writes the log under <exp>/log/log.json (trainer.py log_dir)
+cp "$EXP/log/log.json" docs/artifacts/nbody_fastegnn_log.json.tmp
+mv docs/artifacts/nbody_fastegnn_log.json.tmp docs/artifacts/nbody_fastegnn_log.json
 CKPT="$EXP/state_dict/best_model.ckpt"
 if [ -f "$CKPT" ]; then
-  # temp + mv: a crash mid-eval must not truncate previously-good evidence
+  # temp + rename on the SAME filesystem: a crash mid-eval (or mid-copy)
+  # must not truncate previously-good evidence
   python scripts/evaluate_rollout.py --config_path configs/nbody_fastegnn.yaml \
     --checkpoint "$CKPT" --samples 200 \
-    > /tmp/nbody_rollout_mse.json.tmp
-  mv /tmp/nbody_rollout_mse.json.tmp docs/artifacts/nbody_rollout_mse.json
+    > docs/artifacts/nbody_rollout_mse.json.tmp
+  mv docs/artifacts/nbody_rollout_mse.json.tmp docs/artifacts/nbody_rollout_mse.json
 fi
 echo "artifacts written under docs/artifacts/ — record the best MSEs in BASELINE.md and commit"

@@ -3,7 +3,7 @@
 #
 # Run on a live TPU tunnel (CPU epochs are ~15+ min on this host; TPU epochs
 # with scan_epochs are sub-second). Produces:
-#   - logs/nbody/<exp>/log.json            (loss curves, best MSEs, time_cost)
+#   - logs/nbody/<exp>/log/log.json        (loss curves, best MSEs, time_cost)
 #   - docs/artifacts/nbody_fastegnn_log.json  (tracked copy; logs/ is ignored)
 #   - docs/artifacts/nbody_rollout_mse.json   (rollout MSE with the best ckpt)
 #
@@ -40,26 +40,30 @@ test -f "$NBODY_DONE" \
 # artifact capture as well).
 run_finished() {  # run_finished <last_model.ckpt> <log.json> <epochs>
   # The ckpt's stored epoch is authoritative (a resumed run's own log.json
-  # covers only the resumed span, so log length would under-count).
+  # covers only the resumed span, so log length would under-count). The
+  # trainer writes last_model.ckpt only on eval epochs, so a finished run's
+  # newest ckpt records the LAST EVAL epoch — compare against that, not the
+  # raw epoch budget (else epochs not divisible by test_interval resume
+  # forever).
   python - "$1" "$2" "$3" <<'EOF'
 import json, pickle, sys
-ckpt_epoch = pickle.load(open(sys.argv[1], "rb"))["epoch"]
+payload = pickle.load(open(sys.argv[1], "rb"))
+epochs = int(sys.argv[3])
+interval = int(payload["config"]["log"]["test_interval"])
 best = json.load(open(sys.argv[2]))[0]
-done = "early_stop" in best or ckpt_epoch >= int(sys.argv[3])
+done = "early_stop" in best or payload["epoch"] >= epochs - (epochs % interval)
 raise SystemExit(0 if done else 1)
 EOF
 }
 
 CKPT_ARGS=()
 RUN_TRAINING=1
-EXP=""
 LAST=$(ls -dt logs/nbody/*/state_dict/last_model.ckpt 2>/dev/null | head -1 || true)
 if [ -n "$LAST" ]; then
   PREV_EXP=$(dirname "$(dirname "$LAST")")
   if [ -f "$PREV_EXP/log/log.json" ] && run_finished "$LAST" "$PREV_EXP/log/log.json" "$EPOCHS"; then
-    echo "previous run $PREV_EXP already finished — capturing its artifacts"
+    echo "previous run $PREV_EXP already finished — capturing artifacts only"
     RUN_TRAINING=0
-    EXP="$PREV_EXP/"
   else
     echo "resuming from $LAST"
     CKPT_ARGS=(--checkpoint "$LAST")
@@ -70,9 +74,28 @@ if [ "$RUN_TRAINING" -eq 1 ]; then
   python -u main.py --config_path configs/nbody_fastegnn.yaml --epochs "$EPOCHS" \
     ${CKPT_ARGS[@]+"${CKPT_ARGS[@]}"} \
     2>&1 | tee /tmp/convergence_run.log
-  # newest run dir under logs/nbody
-  EXP=$(ls -dt logs/nbody/*/ | head -1)
 fi
+
+# Capture artifacts from the run dir with the BEST valid loss across all
+# runs, not just the newest: a resumed run restarts best-tracking in a fresh
+# exp dir, so its best ckpt covers only the resumed span — the pre-abort run
+# may hold the true best. (To force a completely FRESH convergence run after
+# code/config changes: rm -rf logs/nbody AND /tmp/hw_done.)
+EXP=$(python - <<'EOF'
+import glob, json, os
+best = (None, float("inf"))
+for log in glob.glob("logs/nbody/*/log/log.json"):
+    try:
+        lv = json.load(open(log))[0]["loss_valid"]
+    except Exception:
+        continue
+    if lv < best[1]:
+        best = (os.path.dirname(os.path.dirname(log)), lv)
+if best[0] is None:
+    raise SystemExit("no run with a log.json found under logs/nbody")
+print(best[0])
+EOF
+)
 mkdir -p docs/artifacts
 # trainer writes the log under <exp>/log/log.json (trainer.py log_dir)
 cp "$EXP/log/log.json" docs/artifacts/nbody_fastegnn_log.json.tmp

@@ -276,3 +276,56 @@ def test_restore_params_ignores_optimizer_wrapping(tmp_path, tiny_setup):
     plain_state = TrainState.create(params, make_optimizer(1e-3))
     with pytest.raises(ValueError, match="incompatible"):
         restore_checkpoint(path, plain_state)
+
+
+def test_resume_equals_uninterrupted(tiny_setup, tmp_path):
+    """Interrupt-and-resume reproduces the uninterrupted run bitwise.
+
+    Per-step PRNG keys derive from (seed, epoch, step) and the loader
+    reshuffles from (seed, epoch) (trainer.run_epoch_train), so restoring
+    last_model.ckpt at epoch k and continuing with start_epoch=k must yield
+    the exact trajectory the unbroken run took — the property the reference's
+    --checkpoint restart flow (main.py:208-220) provides and our convergence
+    automation (scripts/convergence_session.sh) relies on after a mid-run
+    abort."""
+    from distegnn_tpu.config import ConfigDict
+    from distegnn_tpu.train.trainer import train
+
+    model, params, graphs = tiny_setup
+    tx = make_optimizer(1e-3)
+    step = jax.jit(make_train_step(model, tx, mmd_weight=0.03, mmd_sigma=1.5,
+                                   mmd_samples=3))
+    ev = jax.jit(make_eval_step(model))
+
+    def mk_loader():
+        return GraphLoader(GraphDataset(graphs), batch_size=4, shuffle=True, seed=0)
+
+    def mk_config(dirname, epochs):
+        return ConfigDict({
+            "seed": 0,
+            "train": {"epochs": epochs, "early_stop": 100},
+            "log": {"test_interval": 2, "log_dir": str(tmp_path / dirname),
+                    "exp_name": "run", "wandb": {"enable": False}},
+        })
+
+    # uninterrupted run: 6 epochs
+    state_a = TrainState.create(params, tx)
+    state_a, _, _, _ = train(state_a, step, ev, mk_loader(), mk_loader(),
+                             mk_loader(), mk_config("full", 6))
+
+    # interrupted at epoch 4 (last_model.ckpt written on eval epoch 4) ...
+    state_b = TrainState.create(params, tx)
+    train(state_b, step, ev, mk_loader(), mk_loader(), mk_loader(),
+          mk_config("part", 4))
+    ckpt = tmp_path / "part" / "run" / "state_dict" / "last_model.ckpt"
+    fresh = TrainState.create(params, tx)
+    restored, start_epoch, _ = restore_checkpoint(str(ckpt), fresh)
+    assert start_epoch == 4
+
+    # ... resumed for epochs 5..6
+    state_c, _, _, _ = train(restored, step, ev, mk_loader(), mk_loader(),
+                             mk_loader(), mk_config("resumed", 6),
+                             start_epoch=start_epoch)
+
+    for a, c in zip(jax.tree.leaves(state_a.params), jax.tree.leaves(state_c.params)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(c))

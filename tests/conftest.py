@@ -28,3 +28,27 @@ def pytest_configure(config):
 @pytest.fixture
 def rng():
     return np.random.default_rng(43)
+
+
+def make_water3d_h5(base_dir, n_part, t_frames, step_scale, seed):
+    """Synthetic Water-3D raw h5 (the reference's converted DeepMind layout:
+    traj_<k>/position [T,N,3] + particle_type [N]) for train/valid/test —
+    shared by the pipeline and e2e tests. (test_rollout.py keeps its own
+    constant-velocity variant: rollout checks need a different trajectory
+    model.) Returns the data_dir to pass to the processors."""
+    import h5py
+
+    rng = np.random.default_rng(seed)
+    base = os.path.join(str(base_dir), "Water-3D")
+    os.makedirs(base, exist_ok=True)
+    for split in ("train", "valid", "test"):
+        with h5py.File(os.path.join(base, f"{split}.h5"), "w") as f:
+            for k in range(2):
+                g = f.create_group(f"traj_{k}")
+                g["particle_type"] = np.full((n_part,), 5.0)
+                pos = rng.uniform(0, 0.5, size=(1, n_part, 3)).astype(np.float32)
+                steps = rng.normal(
+                    size=(t_frames - 1, n_part, 3)).astype(np.float32) * step_scale
+                g["position"] = np.concatenate(
+                    [pos, pos + np.cumsum(steps, axis=0)], axis=0)
+    return str(base_dir)

@@ -19,21 +19,10 @@ T_FRAMES = 40
 
 @pytest.fixture(scope="module")
 def water3d_dir(tmp_path_factory):
-    import h5py
+    from tests.conftest import make_water3d_h5
 
-    rng = np.random.default_rng(0)
-    d = tmp_path_factory.mktemp("w3d")
-    base = d / "Water-3D"
-    base.mkdir()
-    for split in ("train", "valid", "test"):
-        with h5py.File(base / f"{split}.h5", "w") as f:
-            for k in range(2):
-                g = f.create_group(f"traj_{k}")
-                g["particle_type"] = np.full((N_PART,), 5.0)
-                pos = rng.uniform(0, 0.5, size=(1, N_PART, 3)).astype(np.float32)
-                steps = rng.normal(size=(T_FRAMES - 1, N_PART, 3)).astype(np.float32) * 0.003
-                g["position"] = np.concatenate([pos, pos + np.cumsum(steps, axis=0)], axis=0)
-    return str(d)
+    return make_water3d_h5(tmp_path_factory.mktemp("w3d"),
+                           N_PART, T_FRAMES, step_scale=0.003, seed=0)
 
 
 def test_water3d_cutoff_pipeline(water3d_dir):

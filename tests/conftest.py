@@ -52,3 +52,11 @@ def make_water3d_h5(base_dir, n_part, t_frames, step_scale, seed):
                 g["position"] = np.concatenate(
                     [pos, pos + np.cumsum(steps, axis=0)], axis=0)
     return str(base_dir)
+
+
+def assert_run_artifacts(log_dir):
+    """The shared trainer's on-disk contract: some run dir under log_dir has
+    log/log.json (trainer.py log_dir layout)."""
+    runs = os.listdir(str(log_dir))
+    assert any(os.path.exists(os.path.join(str(log_dir), r, "log", "log.json"))
+               for r in runs)

@@ -1,0 +1,70 @@
+"""The remaining cutoff-mode reference configs executed through main.main():
+protein_fastegnn.yaml and water3d_fastegnn.yaml on synthetic raw data (the
+real datasets are network downloads). The n-body config is exercised against
+the real generated dataset by scripts/convergence_session.sh; the two
+distribute-mode configs have their own e2e tests (test_largefluid_e2e.py,
+test_water3d_e2e.py). Covers the full CLI path: yaml load + CLI overrides →
+preprocessing → loaders → model factory → train loop → log.json.
+Reference flow: main.py:95-229."""
+
+from __future__ import annotations
+
+import os
+
+import numpy as np
+import pytest
+import yaml
+
+import main as main_mod
+
+CONFIG_DIR = os.path.join(os.path.dirname(__file__), "..", "configs")
+
+
+def _patched_yaml(tmp_path, name, data_overrides, log_dir):
+    with open(os.path.join(CONFIG_DIR, name)) as f:
+        cfg = yaml.safe_load(f)
+    cfg["data"].update(data_overrides)
+    cfg["log"]["log_dir"] = log_dir
+    out = str(tmp_path / name)
+    with open(out, "w") as f:
+        yaml.safe_dump(cfg, f)
+    return out
+
+
+from tests.conftest import assert_run_artifacts as _assert_run_artifacts  # noqa: E402
+
+
+@pytest.mark.slow
+def test_protein_yaml_runs_via_main(tmp_path):
+    # synthetic AdK npz (same layout as tests/test_pipelines.py protein_dir)
+    rng = np.random.default_rng(2)
+    base = tmp_path / "raw" / "protein"
+    base.mkdir(parents=True)
+    T, N = 4180, 30
+    start = rng.uniform(0, 20, size=(1, N, 3)).astype(np.float32)
+    steps = rng.normal(size=(T - 1, N, 3)).astype(np.float32) * 0.05
+    np.savez_compressed(
+        base / "adk_backbone.npz",
+        positions=np.concatenate([start, start + np.cumsum(steps, axis=0)], axis=0),
+        charges=rng.uniform(0.1, 1.0, size=(N,)).astype(np.float32))
+
+    log_dir = str(tmp_path / "logs")
+    path = _patched_yaml(tmp_path, "protein_fastegnn.yaml",
+                         {"data_dir": str(tmp_path / "raw")}, log_dir)
+    # the reference's fixed 2481/827/863 split is kept by the processor;
+    # batch 500 keeps the epoch at ~5 steps on the CPU backend
+    main_mod.main(["--config_path", path, "--epochs", "2", "--batch_size", "500"])
+    _assert_run_artifacts(log_dir)
+
+
+@pytest.mark.slow
+def test_water3d_cutoff_yaml_runs_via_main(tmp_path):
+    from tests.conftest import make_water3d_h5
+
+    data_dir = make_water3d_h5(tmp_path / "raw", 40, 40, step_scale=0.003, seed=5)
+    log_dir = str(tmp_path / "logs")
+    path = _patched_yaml(tmp_path, "water3d_fastegnn.yaml",
+                         {"data_dir": data_dir, "max_samples": 6,
+                          "radius": 0.1, "delta_t": 5}, log_dir)
+    main_mod.main(["--config_path", path, "--epochs", "2", "--batch_size", "3"])
+    _assert_run_artifacts(log_dir)

@@ -67,6 +67,6 @@ def test_largefluid_yaml_runs_distributed_metis(fluid_dataset, tmp_path, edge_bl
     assert len(set(counts)) > 1, f"expected uneven metis partitions, got {counts}"
 
     # log.json artifact written by the shared trainer
-    runs = os.listdir(str(tmp_path))
-    assert any(os.path.exists(os.path.join(str(tmp_path), r, "log", "log.json"))
-               for r in runs)
+    from tests.conftest import assert_run_artifacts
+
+    assert_run_artifacts(tmp_path)

@@ -51,6 +51,6 @@ def test_simulation_yaml_runs_distributed_metis(water3d_dataset, tmp_path):
     assert np.isfinite(best["loss_valid"]) and np.isfinite(best["loss_test"])
 
     # log.json artifact written by the shared trainer
-    runs = os.listdir(str(tmp_path))
-    assert any(os.path.exists(os.path.join(str(tmp_path), r, "log", "log.json"))
-               for r in runs)
+    from tests.conftest import assert_run_artifacts
+
+    assert_run_artifacts(tmp_path)

@@ -50,9 +50,20 @@ HIDDEN, LAYERS, CHANNELS = 64, 4, 3
 WARMUP, STEPS = 3, 10
 # Child kill is a last resort: SIGKILLing a live TPU client strands the
 # remote claim and wedges the axon tunnel (observed twice, BASELINE.md) — but
-# without a bound a wedged tunnel hangs the bench forever. 2400 s clears the
-# slowest observed degraded-session child (~6 min) by 6x.
-CHILD_TIMEOUT_S = 2400
+# without a bound a wedged tunnel hangs the bench forever. 1200 s clears the
+# slowest observed degraded-session child (~6 min) by 3x.
+CHILD_TIMEOUT_S = int(os.environ.get("BENCH_CHILD_TIMEOUT_S", 1200))
+# Total wall budget for the auto race. Round 2's lesson (VERDICT r2, weak #2):
+# the driver's own end-of-round timeout killed a bench that was hanging on a
+# wedged tunnel, recording NOTHING, even though an honest-failure JSON path
+# existed. The budget guarantees bench.py prints its line well inside any
+# plausible driver budget, even if that means skipping the tail of the race.
+TOTAL_BUDGET_S = int(os.environ.get("BENCH_BUDGET_S", 2400))
+# Probe child: never acquires the device on a dead tunnel, so it is safe to
+# timeout-kill (scripts/tpu_probe.sh contract). 75 s covers the observed
+# worst-case healthy first-acquire (~40 s incl. backend init).
+PROBE_TIMEOUT_S = 75
+RACE_ARTIFACT = os.path.join("docs", "artifacts", "bench_race_last.json")
 
 # TPU v5e peak: 197 TFLOP/s bf16, ~98.5 TFLOP/s fp32 (public spec sheet).
 PEAK_F32_FLOPS = 98.5e12
@@ -164,7 +175,8 @@ def main():
              "[--impl pallas|einsum] [--seg scatter|cumsum|ell]")
     if "--layout" in args:
         i = args.index("--layout")
-        if i + 1 >= len(args) or args[i + 1] not in ("plain", "blocked", "auto"):
+        if i + 1 >= len(args) or args[i + 1] not in ("plain", "blocked", "auto",
+                                                     "probe"):
             sys.exit(usage)
         layout = args[i + 1]
     if "--impl" in args:
@@ -179,28 +191,110 @@ def main():
         seg = args[i + 1]
 
     edge_block = int(os.environ.get("BENCH_EDGE_BLOCK", 256))
+    if layout == "probe":
+        # Tiny round-trip (matmul + host fetch). On a wedged tunnel this
+        # blocks in acquire without ever claiming the device, so the parent's
+        # timeout-kill is safe (same contract as scripts/tpu_probe.sh).
+        import jax
+        import jax.numpy as jnp
+
+        x = jnp.ones((256, 256))
+        print("PROBE_OK", float((x @ x).sum()))
+        return
     if layout in ("plain", "blocked"):
         print(json.dumps(measure(edge_block if layout == "blocked" else 0,
                                  impl, seg)))
         return
 
-    # auto: measure the candidate lowerings, each in a CHILD process (so a
-    # compiler surprise on new hardware can't kill the bench), and report the
-    # faster real measurement. Candidates: plain-cumsum (scatter-free
-    # prefix-sum aggregation) and plain-scatter. The blocked layouts are
-    # excluded after losing on hardware twice (BASELINE.md round-2 status:
-    # pallas 1067.7 ms vs plain 712-773; einsum 2462.7 vs plain 1653.5 in the
-    # same degraded-tunnel session) - measure them explicitly with --layout
-    # blocked if revisiting.
-    best, fails = None, []
+    # auto: probe-gate, then measure the candidate lowerings, each in a CHILD
+    # process (so a compiler surprise on new hardware can't take down the
+    # bench), and report the fastest real measurement. Candidates:
+    # plain-cumsum (scatter-free prefix-sum aggregation), plain-ell
+    # (fixed-degree chained gathers) and plain-scatter. The blocked layouts
+    # are excluded after losing on hardware twice (BASELINE.md round-2
+    # status: pallas 1067.7 ms vs plain 712-773; einsum 2462.7 vs plain
+    # 1653.5 in the same degraded-tunnel session) — measure them explicitly
+    # with --layout blocked if revisiting.
+    t_start = time.monotonic()
+
+    def remaining():
+        return TOTAL_BUDGET_S - (time.monotonic() - t_start)
+
+    def fail_record(reason):
+        return {
+            "metric": "largefluid_train_nodes_per_sec_per_chip",
+            "value": 0.0,
+            "unit": f"MEASUREMENT FAILED: {reason[:400]}",
+            "vs_baseline": 0.0,
+        }
+
+    self_path = os.path.abspath(__file__)
+    repo_dir = os.path.dirname(self_path)
+
+    def persist_race(records, fails, probe_ok):
+        # Tracked artifact with EVERY child's record, not just the winner:
+        # the race IS the in-session A/B control (cross-session tunnel
+        # variance is 2.2x — BASELINE.md), so the per-lowering table is only
+        # meaningful as a unit. Written even on failure so a dead-tunnel
+        # round still leaves evidence of what was attempted.
+        try:
+            os.makedirs(os.path.join(repo_dir, "docs", "artifacts"), exist_ok=True)
+            path = os.path.join(repo_dir, RACE_ARTIFACT)
+            tmp = path + ".tmp"
+            with open(tmp, "w") as f:
+                json.dump({"probe_ok": probe_ok, "n_nodes": N_NODES,
+                           "note": "single-session race; values comparable "
+                                   "only within this record (2.2x "
+                                   "cross-session tunnel variance)",
+                           "results": records, "failures": fails}, f, indent=1)
+            os.replace(tmp, path)
+        except OSError as e:
+            print(f"bench: artifact write failed: {e!r}", file=sys.stderr)
+
+    # Probe first (round 2 lost its end-of-round number to a wedged tunnel
+    # that hung the measurement children past the driver's budget). On a
+    # dead tunnel this prints the honest-failure JSON in <2 min total.
+    if os.environ.get("BENCH_PROBE", "1") != "0" and plat != "cpu":
+        try:
+            out = subprocess.run([sys.executable, self_path, "--layout", "probe"],
+                                 capture_output=True, text=True,
+                                 timeout=PROBE_TIMEOUT_S, cwd=repo_dir)
+            probe_ok = out.returncode == 0 and "PROBE_OK" in out.stdout
+            reason = f"rc={out.returncode}, stderr tail: {out.stderr[-200:]}"
+        except subprocess.TimeoutExpired:
+            probe_ok, reason = False, f"probe timed out after {PROBE_TIMEOUT_S}s"
+        if not probe_ok:
+            rec = fail_record(f"device probe failed (wedged TPU tunnel?): {reason}")
+            persist_race([], [f"probe: {reason}"], False)
+            print(json.dumps(rec))
+            return
+        # Claim release after a client exits takes >25 s on this tunnel; a
+        # child started immediately can hang in acquire even when healthy.
+        time.sleep(30)
+
+    best, records, fails = None, [], []
+    first = True
     for child_args in (["--layout", "plain", "--seg", "cumsum"],
                        ["--layout", "plain", "--seg", "ell"],
                        ["--layout", "plain"]):
+        # Skip rather than admit a child that could only finish by being
+        # timeout-killed: a timeout SIGKILLs a LIVE client mid-measurement,
+        # which strands the remote claim (the tunnel-wedging hazard). The
+        # slowest observed degraded-session child is ~360 s; require enough
+        # budget that the clamped timeout stays comfortably above that.
+        if remaining() < 480:
+            fails.append(f"{child_args}: skipped (wall budget {TOTAL_BUDGET_S}s "
+                         f"nearly spent)")
+            continue
+        if not first:
+            time.sleep(30)  # claim-release spacing between TPU clients
+        first = False
         try:
             out = subprocess.run(
-                [sys.executable, os.path.abspath(__file__)] + child_args,
-                capture_output=True, text=True, timeout=CHILD_TIMEOUT_S,
-                cwd=os.path.dirname(os.path.abspath(__file__)),
+                [sys.executable, self_path] + child_args,
+                capture_output=True, text=True,
+                timeout=min(CHILD_TIMEOUT_S, remaining() - 60),
+                cwd=repo_dir,
             )
             rec = None
             if out.returncode == 0:
@@ -214,26 +308,26 @@ def main():
             if rec is None:
                 fails.append(f"{child_args}: rc={out.returncode}, "
                              f"stderr tail: {out.stderr[-300:]}")
-            elif best is None or rec["value"] > best["value"]:
-                best = rec
+            else:
+                records.append(rec)
+                if best is None or rec["value"] > best["value"]:
+                    best = rec
+        except subprocess.TimeoutExpired:
+            fails.append(f"{child_args}: timed out")
         except Exception as e:
             fails.append(f"{child_args}: {e!r}")
     for f in fails:
         print(f"bench: child failed ({f})", file=sys.stderr)
+    persist_race(records, fails, True)
     if best is not None:
         print(json.dumps(best))
     else:
-        # Both children failed — almost certainly unreachable hardware (a
+        # All children failed — almost certainly unreachable hardware (a
         # wedged axon tunnel). Do NOT fall back to an in-process measurement:
         # on a wedged tunnel that blocks forever at the first device op, and
         # a hung bench records nothing at all. Emit an honest failure line.
-        print(json.dumps({
-            "metric": "largefluid_train_nodes_per_sec_per_chip",
-            "value": 0.0,
-            "unit": f"MEASUREMENT FAILED (both bench children died; "
-                    f"likely wedged TPU tunnel): {'; '.join(fails)[:300]}",
-            "vs_baseline": 0.0,
-        }))
+        print(json.dumps(fail_record(
+            f"all bench children died (wedged TPU tunnel?): {'; '.join(fails)}")))
 
 
 if __name__ == "__main__":

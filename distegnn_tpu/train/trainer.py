@@ -81,7 +81,11 @@ def train(
     seed = config.seed
     is_main = jax.process_index() == 0
 
-    log_dict = {"epochs": [], "loss": [], "loss_train": [], "epoch_time": []}
+    # start_epoch is recorded so artifact tooling can place the per-epoch
+    # arrays (loss_train, epoch_time — appended from epoch start_epoch+1 on)
+    # at absolute epoch numbers when merging staged/resumed runs.
+    log_dict = {"epochs": [], "loss": [], "loss_train": [], "epoch_time": [],
+                "start_epoch": start_epoch}
     # epoch_index starts at start_epoch (not 0) so a checkpoint-resumed run
     # past the early_stop horizon doesn't spuriously stop before its first eval
     best = {"epoch_index": start_epoch, "loss_valid": 1e8, "loss_test": 1e8,

@@ -29,6 +29,23 @@ NBODY_DONE=${NBODY_DONE:-data/n_body_system/nbody_100/loc_train_charged100_0_0_1
 test -f "$NBODY_DONE" \
   || { echo "dataset missing; run scripts/generate_nbody_chunked.py first"; exit 3; }
 
+# Staged-resume soundness guard: a resumed stage resets early-stop patience
+# (the trainer reinitializes best.epoch_index to start_epoch), so staged
+# execution is early-stop-equivalent to one long run ONLY when the config's
+# early_stop covers its full epoch budget (nbody_fastegnn.yaml: 2500/2500).
+# If someone lowers early_stop, refuse partial stages instead of silently
+# changing the protocol.
+python - "$EPOCHS" <<'EOF' || exit 7
+import sys, yaml
+cfg = yaml.safe_load(open("configs/nbody_fastegnn.yaml"))["train"]
+stage, full = int(sys.argv[1]), int(cfg["epochs"])
+if stage < full and int(cfg["early_stop"]) < full:
+    print(f"REFUSING staged run: early_stop {cfg['early_stop']} < full epoch "
+          f"budget {full}; staging would reset patience at each resume. "
+          "Run the full budget in one invocation or raise early_stop.")
+    raise SystemExit(1)
+EOF
+
 # Resume a previously aborted run (tunnel death mid-training) instead of
 # restarting: the trainer writes last_model.ckpt every test_interval epochs
 # and main.py --checkpoint restores state + start_epoch. The resumed run
@@ -97,9 +114,57 @@ print(best[0])
 EOF
 )
 mkdir -p docs/artifacts
-# trainer writes the log under <exp>/log/log.json (trainer.py log_dir)
-cp "$EXP/log/log.json" docs/artifacts/nbody_fastegnn_log.json.tmp
-mv docs/artifacts/nbody_fastegnn_log.json.tmp docs/artifacts/nbody_fastegnn_log.json
+# Publish a MERGED artifact covering every stage's epochs, not just the best
+# run's span: after staged resumes (100/400/2500) any single log.json covers
+# only its own stage, under-representing the full curve. Eval-epoch numbers
+# are absolute (trainer logs `epoch`, and resumed runs start at start_epoch),
+# so stages concatenate cleanly; keep the [best, log, cfg] triple layout and
+# append a stage manifest at index 3.
+python - "$EXP" <<'EOF'
+import glob, json, os, sys
+best_exp = sys.argv[1]
+stages = []
+for log in sorted(glob.glob("logs/nbody/*/log/log.json"),
+                  key=lambda p: os.path.getmtime(p)):
+    try:
+        b, ld, cfg = json.load(open(log))
+    except Exception:
+        continue
+    stages.append({"exp": os.path.dirname(os.path.dirname(log)),
+                   "best": b, "log": ld, "cfg": cfg})
+if not stages:
+    raise SystemExit("no stage logs found")
+chosen = next(s for s in stages if s["exp"] == best_exp)
+# Dedup EVERY per-epoch array by absolute epoch number (later stages
+# override): a crash-resume re-runs the epochs after the last eval ckpt, so
+# plain concatenation would double-count them. loss_train/epoch_time carry
+# no epoch column; their absolute epoch is start_epoch+1+i (trainer records
+# start_epoch in the log dict; old logs without it are whole runs from 0).
+seen, seen_tr, seen_dt = {}, {}, {}
+for s in stages:
+    ld = s["log"]
+    for e, l in zip(ld.get("epochs", []), ld.get("loss", [])):
+        seen[e] = l
+    e0 = int(ld.get("start_epoch", 0))
+    for i, (tr, dt) in enumerate(zip(ld.get("loss_train", []),
+                                     ld.get("epoch_time", []))):
+        seen_tr[e0 + 1 + i] = tr
+        seen_dt[e0 + 1 + i] = dt
+merged = {"epochs": sorted(seen),
+          "loss": [seen[e] for e in sorted(seen)],
+          "train_epochs": sorted(seen_tr),
+          "loss_train": [seen_tr[e] for e in sorted(seen_tr)],
+          "epoch_time": [seen_dt[e] for e in sorted(seen_dt)]}
+manifest = [{"exp": s["exp"],
+             "eval_epoch_span": [min(s["log"]["epochs"]), max(s["log"]["epochs"])]
+             if s["log"].get("epochs") else None,
+             "best": s["best"]} for s in stages]
+out = [chosen["best"], merged, chosen["cfg"], {"stages": manifest}]
+tmp = "docs/artifacts/nbody_fastegnn_log.json.tmp"
+with open(tmp, "w") as f:
+    json.dump(out, f, indent=4)
+os.replace(tmp, "docs/artifacts/nbody_fastegnn_log.json")
+EOF
 CKPT="$EXP/state_dict/best_model.ckpt"
 if [ -f "$CKPT" ]; then
   # temp + rename on the SAME filesystem: a crash mid-eval (or mid-copy)

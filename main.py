@@ -166,22 +166,18 @@ def main(argv=None):
     # (train/scan_epoch.py) when the dataset fits in HBM — kills the
     # per-minibatch dispatch latency that dominates small-graph training
     scan_runner = None
-    flag = config.train.scan_epochs
-    if flag == "auto" and jax.default_backend() == "cpu":
-        flag = False  # local CPU has no dispatch latency; scan only adds compile
-    if flag is True or flag == "auto":
-        from distegnn_tpu.train.scan_epoch import ScanEpochRunner, dataset_nbytes
+    from distegnn_tpu.train.scan_epoch import (
+        ScanEpochRunner,
+        dataset_nbytes,
+        scan_enabled,
+    )
 
-        # budget: ~40% of device memory (params/opt/activations need the rest);
-        # memory_stats is unavailable on some backends -> assume 16 GB HBM
-        stats = jax.devices()[0].memory_stats() or {}
-        budget = int(stats.get("bytes_limit", 16 << 30) * 0.4)
-        total = sum(dataset_nbytes(l) for l in (loader_train, loader_valid, loader_test))
-        if total <= budget or flag is True:
-            scan_runner = ScanEpochRunner(
-                train_step, eval_step, loader_train, config.seed,
-                loader_valid=loader_valid, loader_test=loader_test)
-            print(f"scan_epochs: on ({total / 2**30:.2f} GiB device-resident)")
+    total = sum(dataset_nbytes(l) for l in (loader_train, loader_valid, loader_test))
+    if scan_enabled(config.train.scan_epochs, total):
+        scan_runner = ScanEpochRunner(
+            train_step, eval_step, loader_train, config.seed,
+            loader_valid=loader_valid, loader_test=loader_test)
+        print(f"scan_epochs: on ({total / 2**30:.2f} GiB device-resident)")
 
     state, best_state, best, log_dict = train(
         state, train_step, eval_step, loader_train, loader_valid, loader_test,

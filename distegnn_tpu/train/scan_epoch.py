@@ -281,11 +281,12 @@ class DistributedScanRunner:
             def body(st, inp):
                 idx, k = inp
                 st, metrics = device_train_step(st, pick(data, idx), k)
-                return st, metrics["loss"]
+                return st, (metrics["loss"],
+                            metrics.get("batch_consistency", jnp.float32(0)))
 
-            state, losses = jax.lax.scan(body, state, (perm, keys))
+            state, (losses, cons) = jax.lax.scan(body, state, (perm, keys))
             # drop_last equal batch sizes -> plain mean == weighted average
-            return state, jnp.mean(losses)
+            return state, jnp.mean(losses), jnp.max(cons)
 
         def run_eval(params, data, perm):
             def body(_, idx):
@@ -297,7 +298,7 @@ class DistributedScanRunner:
         self._run_train = jax.jit(jax.shard_map(
             run_train, mesh=mesh,
             in_specs=(P(), data_spec, perm_spec, P()),
-            out_specs=(P(), P()), check_vma=False))
+            out_specs=(P(), P(), P()), check_vma=False))
         self._run_eval = None
         if device_eval_step is not None:
             self._run_eval = jax.jit(jax.shard_map(
@@ -319,7 +320,11 @@ class DistributedScanRunner:
         perm = self._perm_array(self.loader.loaders[0]._order(),
                                 self.num_steps, self.draw)
         epoch_key = jax.random.fold_in(jax.random.PRNGKey(self.seed), epoch)
-        state, loss = self._run_train(state, self.data_train, perm, epoch_key)
+        state, loss, cons = self._run_train(state, self.data_train, perm,
+                                            epoch_key)
+        from distegnn_tpu.train.trainer import assert_batch_consistency
+
+        assert_batch_consistency(cons, epoch)
         return state, loss  # loss: device scalar; trainer fetches once
 
     def eval_epoch(self, params, split: str) -> float:

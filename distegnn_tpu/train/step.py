@@ -151,7 +151,24 @@ def make_train_step(model, tx: optax.GradientTransformation, mmd_weight: float,
         updates, opt_state = tx.update(grads, state.opt_state, state.params)
         params = optax.apply_updates(state.params, updates)
         new_state = TrainState(params=params, opt_state=opt_state, step=state.step + 1)
-        return new_state, {"loss": logged, "loss_with_mmd": _psum(loss, axes)}
+        metrics = {"loss": logged, "loss_with_mmd": _psum(loss, axes)}
+        if axis_name is not None:
+            # In-step cross-rank data-consistency check (reference
+            # utils/train.py:55-61 all_gathers loc_mean and asserts it EVERY
+            # step): every partition of a graph carries the graph's GLOBAL
+            # loc_mean, so across the graph axis the values must be bitwise
+            # identical. max|m - pmin(m)| pmax'd over the axis is exactly 0
+            # iff all ranks fed the same logical batch. Traced into the step:
+            # one [B,3] collective — free next to the per-layer psums; the
+            # trainer asserts the scalar host-side once per eval interval.
+            # pmin spans the graph axis only (the data axis holds DIFFERENT
+            # graphs); the final pmax spans the whole mesh so every process
+            # sees a nonzero residual even when the drift is on another
+            # host's data row.
+            m = batch.loc_mean
+            resid = jnp.max(jnp.abs(m - jax.lax.pmin(m, axis_name)))
+            metrics["batch_consistency"] = jax.lax.pmax(resid, axes)
+        return new_state, metrics
 
     return step
 

@@ -17,6 +17,7 @@ import time
 from typing import Callable, Optional
 
 import jax
+import jax.numpy as jnp
 import numpy as np
 
 
@@ -36,7 +37,7 @@ def run_epoch_train(train_step: Callable, state, loader, seed: int, epoch: int):
     ``float(loss)`` per step, forcing a blocking device round-trip per
     micro-batch and defeating XLA async dispatch (VERDICT r1 weak #3)."""
     loader.set_epoch(epoch)
-    total, counter = None, 0.0
+    total, counter, cons = None, 0.0, None
     for step_idx, batch in enumerate(loader):
         key = jax.random.PRNGKey(seed)
         key = jax.random.fold_in(jax.random.fold_in(key, epoch), step_idx)
@@ -45,8 +46,27 @@ def run_epoch_train(train_step: Callable, state, loader, seed: int, epoch: int):
         contrib = metrics["loss"] * bsz
         total = contrib if total is None else total + contrib
         counter += bsz
+        if "batch_consistency" in metrics:  # device-side max, no extra sync
+            c = metrics["batch_consistency"]
+            cons = c if cons is None else jnp.maximum(cons, c)
     avg = float(total) / max(counter, 1.0) if total is not None else 0.0
+    assert_batch_consistency(cons, epoch)
     return state, avg
+
+
+def assert_batch_consistency(cons, epoch: int) -> None:
+    """Host-side assert of the in-step loc_mean residual (train/step.py):
+    every graph-axis rank must have fed the same logical batch — the
+    reference's per-step all_gather check (utils/train.py:55-61) at the cost
+    of one scalar fetch per epoch (the epoch's loss fetch already syncs)."""
+    # NOT `> 0`: a corrupted shard can carry NaN, and NaN residuals must
+    # fail too — only an exactly-zero residual proves bitwise-identical
+    # loc_mean across ranks.
+    if cons is not None and not float(cons) == 0.0:
+        raise AssertionError(
+            f"cross-rank batch mismatch at epoch {epoch}: loc_mean residual "
+            f"{float(cons):g} != 0 — hosts/partitions fed different logical "
+            "batches (loader order drift or corrupted shard data)")
 
 
 def run_epoch_eval(eval_step: Callable, params, loader):

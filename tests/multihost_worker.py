@@ -46,10 +46,16 @@ def build_problem():
     return jax.tree.map(lambda *xs: np.stack(xs, axis=0), *stacks)
 
 
-def run():
+def run(corrupt: bool = False):
     """Build the global mesh over ALL devices (local or cross-process), run
-    STEPS train steps + one eval. Returns (train_loss, eval_loss) floats —
-    identical on every process because state is replicated."""
+    STEPS train steps + one eval. Returns (train_loss, eval_loss,
+    consistency_residual) floats — identical on every process because state
+    is replicated.
+
+    ``corrupt=True`` injects the failure the in-step consistency check exists
+    to catch (VERDICT r2 weak #6): process 1 perturbs loc_mean of partition 0
+    in ITS host copy before the global put — a host-data drift invisible to
+    everything except the cross-rank check."""
     import jax
 
     from distegnn_tpu.models.fast_egnn import FastEGNN
@@ -58,6 +64,10 @@ def run():
     from distegnn_tpu.train import TrainState, make_optimizer
 
     batch = build_problem()
+    if corrupt and jax.process_index() == 1:
+        lm = np.array(batch.loc_mean)
+        lm[:, 0] += 0.25  # partition 0 only: within-axis divergence
+        batch = batch.replace(loc_mean=lm)
     mesh = make_mesh(n_graph=NPART, n_data=DP, devices=jax.devices())
     model = FastEGNN(node_feat_nf=2, edge_attr_nf=2, hidden_nf=16,
                      virtual_channels=3, n_layers=2, axis_name=GRAPH_AXIS)
@@ -71,7 +81,8 @@ def run():
     gb = global_batch_putter(mesh)(batch)
     for i in range(STEPS):
         state, metrics = train_step(state, gb, jax.random.PRNGKey(3 + i))
-    return float(metrics["loss"]), float(eval_step(state.params, gb))
+    return (float(metrics["loss"]), float(eval_step(state.params, gb)),
+            float(metrics["batch_consistency"]))
 
 
 def main():
@@ -91,8 +102,9 @@ def main():
                                num_processes=2, process_id=pid)
     assert len(jax.devices()) == 8, jax.devices()
     assert len(jax.local_devices()) == 4
-    loss, ev = run()
-    print(f"RESULT {pid} {loss:.10f} {ev:.10f}", flush=True)
+    corrupt = len(sys.argv) > 3 and sys.argv[3] == "corrupt"
+    loss, ev, cons = run(corrupt=corrupt)
+    print(f"RESULT {pid} {loss:.10f} {ev:.10f} {cons:.10f}", flush=True)
 
 
 if __name__ == "__main__":

@@ -47,3 +47,56 @@ def test_batch_fingerprint_is_order_sensitive():
     b["x"] = b["x"][::-1].copy()
     assert batch_fingerprint(a) != batch_fingerprint(b)
     assert tree_fingerprint(a) == tree_fingerprint({"x": a["x"], "y": a["y"]})
+
+
+def test_in_step_batch_consistency_detects_partition_drift():
+    """The traced in-step loc_mean check (train/step.py): zero on clean data,
+    nonzero when one partition's host data drifted; assert_batch_consistency
+    raises on the nonzero residual (reference utils/train.py:55-61 parity)."""
+    import jax
+    import numpy as np
+    import pytest
+    from jax.sharding import PartitionSpec as P
+
+    from distegnn_tpu.data import build_nbody_graph
+    from distegnn_tpu.data.partition import split_graph
+    from distegnn_tpu.models.fast_egnn import FastEGNN
+    from distegnn_tpu.ops.graph import pad_graphs
+    from distegnn_tpu.parallel.launch import global_batch_putter, make_distributed_steps
+    from distegnn_tpu.parallel.mesh import GRAPH_AXIS, make_mesh
+    from distegnn_tpu.train import TrainState, make_optimizer
+    from distegnn_tpu.train.trainer import assert_batch_consistency
+
+    rng = np.random.default_rng(0)
+    n = 24
+    loc = rng.normal(size=(n, 3))
+    g = build_nbody_graph(loc, rng.normal(size=(n, 3)),
+                          rng.choice([1.0, -1.0], size=(n, 1)),
+                          loc * 1.01, radius=-1.0)
+    parts = split_graph(g, 2, "random", inner_radius=2.5, seed=1)
+    n_max = max(p["loc"].shape[0] for p in parts)
+    e_max = max(p["edge_index"].shape[1] for p in parts)
+    pbs = [pad_graphs([p], max_nodes=n_max + 2, max_edges=e_max + 8) for p in parts]
+    batch = jax.tree.map(lambda *xs: np.stack(xs, axis=0), *pbs)
+
+    mesh = make_mesh(n_graph=2, devices=jax.devices()[:2])
+    model = FastEGNN(node_feat_nf=2, edge_attr_nf=2, hidden_nf=8,
+                     virtual_channels=2, n_layers=1, axis_name=GRAPH_AXIS)
+    params = model.copy(axis_name=None).init(
+        jax.random.PRNGKey(0), jax.tree.map(lambda x: x[0], batch))
+    tx = make_optimizer(1e-3)
+    step, _ = make_distributed_steps(model, tx, mesh, mmd_weight=0.0,
+                                     mmd_sigma=1.0, mmd_samples=2)
+    put = global_batch_putter(mesh)
+
+    state = TrainState.create(params, tx)
+    _, metrics = step(state, put(batch), jax.random.PRNGKey(1))
+    assert float(metrics["batch_consistency"]) == 0.0
+    assert_batch_consistency(metrics["batch_consistency"], epoch=1)  # no raise
+
+    lm = np.array(batch.loc_mean)
+    lm[1] += 0.5  # partition 1's host copy drifts
+    _, metrics = step(state, put(batch.replace(loc_mean=lm)), jax.random.PRNGKey(1))
+    assert float(metrics["batch_consistency"]) > 0.1
+    with pytest.raises(AssertionError, match="batch mismatch"):
+        assert_batch_consistency(metrics["batch_consistency"], epoch=1)

@@ -36,39 +36,58 @@ def _free_port() -> int:
         return s.getsockname()[1]
 
 
-@pytest.mark.slow
-def test_two_process_world_matches_single_process():
+def _run_workers(*extra_args):
+    """Launch the two-process world and return parsed per-process results.
+    PYTHONPATH is repo root only: site-packages come from the interpreter
+    itself, and any extra PJRT plugin dirs on the inherited path (e.g. an
+    unreachable TPU tunnel plugin) would register during
+    jax.distributed.initialize and hang the CPU-only workers."""
     port = _free_port()
     env = dict(os.environ)
     env.pop("PYTHONWARNINGS", None)
-    # minimal PYTHONPATH = repo root only: site-packages come from the
-    # interpreter itself, and any extra PJRT plugin dirs on the inherited
-    # path (e.g. an unreachable TPU tunnel plugin) would register during
-    # jax.distributed.initialize and hang the CPU-only workers
     env["PYTHONPATH"] = os.path.dirname(os.path.dirname(_WORKER))
     procs = [
-        subprocess.Popen([sys.executable, _WORKER, str(port), str(pid)],
-                         stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
-                         text=True, env=env)
+        subprocess.Popen(
+            [sys.executable, _WORKER, str(port), str(pid), *extra_args],
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+            text=True, env=env)
         for pid in (0, 1)
     ]
     outs = [p.communicate(timeout=420)[0] for p in procs]
     for p, out in zip(procs, outs):
         assert p.returncode == 0, f"worker failed:\n{out}"
-
     results = {}
     for out in outs:
         for line in out.splitlines():
             if line.startswith("RESULT "):
-                _, pid, loss, ev = line.split()
-                results[int(pid)] = (float(loss), float(ev))
+                _, pid, loss, ev, cons = line.split()
+                results[int(pid)] = (float(loss), float(ev), float(cons))
     assert set(results) == {0, 1}, f"missing results: {outs}"
+    return results
+
+
+@pytest.mark.slow
+def test_two_process_world_matches_single_process():
+    results = _run_workers()
 
     # replicated-state invariant: both processes computed identical numbers
     np.testing.assert_allclose(results[0], results[1], rtol=0, atol=0)
 
+    # clean data -> the in-step consistency residual is exactly zero
+    assert results[0][2] == 0.0
+
     # multi-host == single-process on the same 8-device problem
     worker = _load_worker()
-    loss_sp, ev_sp = worker.run()
-    np.testing.assert_allclose(results[0], (loss_sp, ev_sp), rtol=1e-6)
-    assert np.isfinite(loss_sp) and np.isfinite(ev_sp)
+    loss_sp, ev_sp, cons_sp = worker.run()
+    np.testing.assert_allclose(results[0][:2], (loss_sp, ev_sp), rtol=1e-6)
+    assert np.isfinite(loss_sp) and np.isfinite(ev_sp) and cons_sp == 0.0
+
+
+@pytest.mark.slow
+def test_two_process_detects_injected_batch_mismatch():
+    """Negative path (VERDICT r2 weak #6): when one host feeds drifted data,
+    the traced in-step check must DETECT it — a nonzero residual on every
+    process, where the clean run's is exactly zero."""
+    results = _run_workers("corrupt")
+    # the collective makes the residual global: BOTH processes see it
+    assert results[0][2] > 0.1 and results[1][2] > 0.1, results

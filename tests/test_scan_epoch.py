@@ -71,8 +71,15 @@ def test_scan_epoch_matches_host_loop():
 
 def _part_datasets(rng, n_parts=2, n_graphs=8, n=12):
     """Independent per-partition toy shards (parity needs identical inputs on
-    both paths, not a physically meaningful partitioning)."""
-    return [_toy_dataset(rng, n_graphs=n_graphs, n=n) for _ in range(n_parts)]
+    both paths, not a physically meaningful partitioning) — except loc_mean,
+    which partitions of one graph genuinely share (it is the GLOBAL mean;
+    the in-step consistency check asserts exactly that)."""
+    dss = [_toy_dataset(rng, n_graphs=n_graphs, n=n) for _ in range(n_parts)]
+    for i in range(n_graphs):
+        mean = np.mean([ds.graphs[i]["loc"] for ds in dss], axis=(0, 1))
+        for ds in dss:
+            ds.graphs[i]["loc_mean"] = mean.astype(np.float32)
+    return dss
 
 
 @pytest.mark.parametrize("dp", [1, 2])

@@ -1,0 +1,97 @@
+"""Real-format Water-3D ingestion (VERDICT r2 next-round #7): write a GENUINE
+DeepMind learning_to_simulate tfrecord (tf.train.SequenceExample records via
+TFRecordWriter — byte-identical framing/proto layout to the public dataset,
+reference dataset_generation/Water-3D/tfrecord_to_h5.py) and run the in-tree
+converter on it. The zero-egress build host cannot download the real 15k-
+trajectory dataset; this pins the FORMAT path so a user pointing the script
+at the public files gets the documented h5 layout."""
+
+from __future__ import annotations
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+tf = pytest.importorskip("tensorflow")
+
+
+def _write_tfrecord(path: str, trajs):
+    with tf.io.TFRecordWriter(path) as w:
+        for key, (ptype, pos) in enumerate(trajs):
+            ex = tf.train.SequenceExample(
+                context=tf.train.Features(feature={
+                    "key": tf.train.Feature(
+                        int64_list=tf.train.Int64List(value=[key])),
+                    "particle_type": tf.train.Feature(
+                        bytes_list=tf.train.BytesList(
+                            value=[ptype.astype(np.int64).tobytes()])),
+                }),
+                feature_lists=tf.train.FeatureLists(feature_list={
+                    "position": tf.train.FeatureList(feature=[
+                        tf.train.Feature(bytes_list=tf.train.BytesList(
+                            value=[frame.astype(np.float32).tobytes()]))
+                        for frame in pos
+                    ]),
+                }),
+            )
+            w.write(ex.SerializeToString())
+
+
+@pytest.mark.slow
+def test_tfrecord_to_h5_roundtrip(tmp_path):
+    import h5py
+
+    from scripts.water3d_tfrecord_to_h5 import convert
+
+    rng = np.random.default_rng(0)
+    trajs = []
+    for _ in range(2):
+        n = int(rng.integers(20, 30))
+        ptype = np.full(n, 5, np.int64)
+        pos = rng.uniform(0.1, 0.9, size=(7, n, 3)).astype(np.float32)
+        trajs.append((ptype, pos))
+    _write_tfrecord(str(tmp_path / "valid.tfrecord"), trajs)
+
+    out = convert(str(tmp_path), "valid.tfrecord")
+    with h5py.File(out, "r") as hf:
+        assert sorted(hf.keys()) == ["00000", "00001"]
+        for i, (ptype, pos) in enumerate(trajs):
+            g = hf[str(i).zfill(5)]
+            np.testing.assert_array_equal(g["particle_type"][:], ptype)
+            np.testing.assert_allclose(g["position"][:], pos, rtol=0)
+
+
+@pytest.mark.slow
+def test_converted_h5_feeds_water3d_pipeline(tmp_path):
+    """The converted h5 must be readable by the Water-3D training pipeline —
+    the full real-artifact path tfrecord -> h5 -> GraphDataset."""
+    import h5py
+
+    from scripts.water3d_tfrecord_to_h5 import convert
+
+    rng = np.random.default_rng(1)
+    n = 40
+    trajs = []
+    for _ in range(2):
+        ptype = np.full(n, 5, np.int64)
+        pos = rng.uniform(0.1, 0.9, size=(20, n, 3)).astype(np.float32)
+        trajs.append((ptype, pos))
+    d = tmp_path / "Water-3D"
+    d.mkdir()
+    for split in ("train", "valid", "test"):
+        _write_tfrecord(str(d / f"{split}.tfrecord"), trajs)
+        convert(str(d), f"{split}.tfrecord")
+
+    from distegnn_tpu.data import GraphDataset
+    from distegnn_tpu.data.water3d import process_water3d_cutoff
+
+    paths = process_water3d_cutoff(str(tmp_path), "Water-3D", max_samples=4,
+                                   radius=0.5, delta_t=3, cutoff_rate=0.0)
+    ds = GraphDataset(paths[1])  # valid split
+    assert len(ds) >= 1
+    g = ds[0]
+    assert g["loc"].shape == (n, 3) and np.isfinite(g["loc"]).all()
+    assert g["edge_index"].shape[0] == 2 and g["edge_index"].shape[1] > 0
+    assert np.isfinite(g["target"]).all()

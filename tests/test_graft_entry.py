@@ -27,3 +27,51 @@ def test_dryrun_multichip_8():
     # asserts internally (finiteness, metis unevenness); conftest provides
     # the 8 virtual CPU devices the driver's env would
     graft_entry.dryrun_multichip(8)
+
+
+def test_bench_cpu_competitors_classification(tmp_path):
+    """bench.py's measurement-window pause must STOP only provably CPU-pinned
+    repo workloads: an unpinned main.py (possibly a live TPU client) and the
+    bench's own ancestors must never be candidates (SIGSTOPping a live
+    client wedges the tunnel; freezing an ancestor deadlocks)."""
+    import importlib.util
+    import os
+    import subprocess
+    import sys
+    import time
+
+    spec = importlib.util.spec_from_file_location(
+        "bench_mod", os.path.join(os.path.dirname(__file__), "..", "bench.py"))
+    bench = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(bench)
+
+    fake = tmp_path / "fake_main.py"
+    fake.write_text("import time; time.sleep(30)\n")
+    env_cpu = dict(os.environ, JAX_PLATFORMS="cpu")
+    env_tpu = {k: v for k, v in os.environ.items()
+               if k not in ("JAX_PLATFORMS", "BENCH_PLATFORM")}
+    cpu_proc = subprocess.Popen(
+        [sys.executable, str(fake), "--config_path", "main.py --config_path x"],
+        env=env_cpu)
+    tpu_proc = subprocess.Popen(
+        [sys.executable, str(fake), "--config_path", "main.py --config_path x"],
+        env=env_tpu)
+    try:
+        time.sleep(0.5)
+        pids, ambiguous = bench.cpu_competitors()
+        assert cpu_proc.pid in pids          # CPU-pinned -> pausable
+        assert tpu_proc.pid not in pids      # ambiguous -> untouchable
+        assert tpu_proc.pid in ambiguous     # ...but surfaced as contention
+        assert os.getpid() not in pids       # never our own process tree
+        assert os.getppid() not in pids
+
+        # already-stopped processes are not ours to resume -> not pausable
+        os.kill(cpu_proc.pid, 19)  # SIGSTOP
+        time.sleep(0.2)
+        pids2, _ = bench.cpu_competitors()
+        assert cpu_proc.pid not in pids2
+    finally:
+        cpu_proc.kill()
+        tpu_proc.kill()
+        cpu_proc.wait()
+        tpu_proc.wait()

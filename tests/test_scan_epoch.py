@@ -173,3 +173,73 @@ def test_stack_sharded_drops_pair_on_asymmetric_partition():
     data = stack_sharded_dataset(sharded, mesh)
     assert data.edge_pair is None
     assert data.loc.shape[:2] == (2, 4)   # [P, G, ...]
+
+
+def _assert_resume_equivalent(make_runner, params, tx):
+    """4 scanned epochs == 2 epochs + checkpoint round-trip into a FRESH
+    runner + 2 more — the staged TPU convergence protocol
+    (scripts/convergence_session.sh: scan_epochs on, resume from
+    last_model.ckpt between stages)."""
+    import os
+    import tempfile
+
+    from distegnn_tpu.train.checkpoint import restore_checkpoint, save_checkpoint
+
+    runner = make_runner()
+    state_a = TrainState.create(params, tx)
+    for epoch in (1, 2, 3, 4):
+        state_a, _ = runner.train_epoch(state_a, epoch)
+
+    state_b = TrainState.create(params, tx)
+    for epoch in (1, 2):
+        state_b, _ = runner.train_epoch(state_b, epoch)
+    with tempfile.TemporaryDirectory() as d:
+        ckpt = os.path.join(d, "last.ckpt")
+        save_checkpoint(ckpt, state_b, epoch=2)
+        state_c = TrainState.create(params, tx)
+        state_c, start_epoch, _ = restore_checkpoint(ckpt, state_c)
+    assert start_epoch == 2
+    runner2 = make_runner()
+    for epoch in (3, 4):
+        state_c, _ = runner2.train_epoch(state_c, epoch)
+
+    fa = ravel_pytree(state_a.params)[0]
+    fc = ravel_pytree(state_c.params)[0]
+    np.testing.assert_array_equal(np.asarray(fc), np.asarray(fa))
+
+
+def test_scan_resume_equals_uninterrupted():
+    rng = np.random.default_rng(17)
+    ds = _toy_dataset(rng)
+    mk = lambda shuffle: GraphLoader(ds, batch_size=4, shuffle=shuffle, seed=9)
+    model = FastEGNN(node_feat_nf=1, edge_attr_nf=2, hidden_nf=8,
+                     virtual_channels=2, n_layers=2)
+    tx = make_optimizer(1e-3, weight_decay=0.0, clip_norm=0.3)
+    params = model.init(jax.random.PRNGKey(0), next(iter(mk(False))))
+    train_step = jax.jit(make_train_step(model, tx, mmd_weight=0.01,
+                                         mmd_sigma=1.5, mmd_samples=2))
+    _assert_resume_equivalent(
+        lambda: ScanEpochRunner(train_step, None, mk(True), 9), params, tx)
+
+
+def test_distributed_scan_resume_equals_uninterrupted():
+    from distegnn_tpu.data.loader import ShardedGraphLoader
+    from distegnn_tpu.parallel.launch import make_device_steps
+    from distegnn_tpu.parallel.mesh import make_mesh
+    from distegnn_tpu.train.scan_epoch import DistributedScanRunner
+
+    rng = np.random.default_rng(23)
+    datasets = _part_datasets(rng, n_parts=2)
+    mesh = make_mesh(n_graph=2, devices=jax.devices()[:2])
+    mk = lambda: ShardedGraphLoader(datasets, batch_size=2, shuffle=True, seed=7)
+
+    model = FastEGNN(node_feat_nf=1, edge_attr_nf=2, hidden_nf=8,
+                     virtual_channels=2, n_layers=2, axis_name="graph")
+    tx = make_optimizer(1e-3, weight_decay=0.0, clip_norm=0.3)
+    sample = next(iter(mk()))
+    params = model.copy(axis_name=None).init(
+        jax.random.PRNGKey(0), jax.tree.map(lambda x: x[0], sample))
+    dstep, _ = make_device_steps(model, tx, mesh, mmd_weight=0.01,
+                                 mmd_sigma=1.5, mmd_samples=2)
+    _assert_resume_equivalent(
+        lambda: DistributedScanRunner(dstep, None, mesh, mk(), 7), params, tx)

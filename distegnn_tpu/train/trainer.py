@@ -148,6 +148,23 @@ def train(
         # so dt_epoch covers the full device time of the epoch
         log_dict["epoch_time"].append(round(dt_epoch, 4))
 
+        # failure detection (SURVEY §5.3, beyond reference parity): a
+        # diverged run never recovers on its own, and unattended hardware
+        # sessions (scripts/convergence_session.sh) would otherwise burn the
+        # whole tunnel window training on NaN. Record the diagnosis in
+        # log.json and stop; the last good checkpoint (last eval epoch)
+        # remains on disk for a lower-LR resume.
+        if not np.isfinite(loss_train):
+            # repr(), not the float: json.dump would emit a bare NaN token,
+            # which strict RFC-8259 consumers (jq, JSON.parse) reject
+            best["diverged"] = {"epoch": epoch, "loss_train": repr(loss_train)}
+            if is_main:
+                print(f"DIVERGED at epoch {epoch}: train loss {loss_train}; "
+                      "stopping (resume from the last checkpoint with a "
+                      "lower lr)", flush=True)
+            _write_log_json(log_dir, best, log_dict, config, start, is_main and log)
+            break
+
         if epoch % log_cfg.test_interval == 0:
             if scan_runner is not None:
                 loss_valid = scan_runner.eval_epoch(state.params, "valid")
@@ -211,13 +228,26 @@ def _save(ckpt_dir, name, state, epoch, losses, config):
     save_checkpoint(os.path.join(ckpt_dir, name), state, epoch, losses=losses, config=cfg)
 
 
+def _sanitize_nonfinite(log_dict):
+    """Replace non-finite floats with None (json null): json.dump would emit
+    bare NaN/Infinity tokens, which strict RFC-8259 consumers reject — and a
+    diverged run DOES put NaN in the loss curves."""
+    def fix(v):
+        if isinstance(v, float) and not np.isfinite(v):
+            return None
+        return v
+
+    return {k: [fix(v) for v in vals] if isinstance(vals, list) else vals
+            for k, vals in log_dict.items()}
+
+
 def _write_log_json(log_dir, best, log_dict, config, start, enabled):
     if not enabled:
         return
     best["time_cost"] = time.perf_counter() - start
     cfg = config.to_dict() if hasattr(config, "to_dict") else dict(config)
     with open(os.path.join(log_dir, "log.json"), "w") as f:
-        json.dump([best, log_dict, cfg], f, indent=4)
+        json.dump([best, _sanitize_nonfinite(log_dict), cfg], f, indent=4)
 
 
 def _init_wandb(config, exp_dir):

@@ -68,7 +68,8 @@ payload = pickle.load(open(sys.argv[1], "rb"))
 epochs = int(sys.argv[3])
 interval = int(payload["config"]["log"]["test_interval"])
 best = json.load(open(sys.argv[2]))[0]
-done = "early_stop" in best or payload["epoch"] >= epochs - (epochs % interval)
+done = ("early_stop" in best or "diverged" in best
+        or payload["epoch"] >= epochs - (epochs % interval))
 raise SystemExit(0 if done else 1)
 EOF
 }

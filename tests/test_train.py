@@ -329,3 +329,46 @@ def test_resume_equals_uninterrupted(tiny_setup, tmp_path):
 
     for a, c in zip(jax.tree.leaves(state_a.params), jax.tree.leaves(state_c.params)):
         np.testing.assert_array_equal(np.asarray(a), np.asarray(c))
+
+
+def test_divergence_stops_training(tiny_setup, tmp_path):
+    """SURVEY §5.3 failure detection: a non-finite train loss must stop the
+    run immediately (unattended hardware sessions would otherwise burn the
+    whole window training on NaN) and record the diagnosis in log.json."""
+    import json
+    import os
+
+    from distegnn_tpu.config import ConfigDict
+    from distegnn_tpu.train.trainer import train
+
+    model, params, graphs = tiny_setup
+    tx = make_optimizer(1e-3)
+
+    calls = {"n": 0}
+
+    def exploding_step(state, batch, key):
+        calls["n"] += 1
+        # diverge partway through epoch 2
+        loss = jnp.float32(jnp.nan) if calls["n"] > 3 else jnp.float32(0.5)
+        return state.replace(step=state.step + 1), {"loss": loss}
+
+    config = ConfigDict({
+        "seed": 0,
+        "train": {"epochs": 10, "early_stop": 100},
+        "log": {"test_interval": 2, "log_dir": str(tmp_path),
+                "exp_name": "run", "wandb": {"enable": False}},
+    })
+    state = TrainState.create(params, tx)
+    _, _, best, log_dict = train(
+        state, exploding_step, lambda p, b: jnp.float32(0.1),
+        GraphLoader(GraphDataset(graphs), batch_size=4, shuffle=True, seed=0),
+        GraphLoader(GraphDataset(graphs), batch_size=4),
+        GraphLoader(GraphDataset(graphs), batch_size=4),
+        config)
+    assert "diverged" in best
+    assert len(log_dict["loss_train"]) < 10  # stopped early
+    raw = open(os.path.join(tmp_path, "run", "log", "log.json")).read()
+    logged = json.loads(raw, parse_constant=lambda c: pytest.fail(
+        f"non-RFC-8259 token {c} in log.json"))  # strict: no bare NaN/Infinity
+    assert "diverged" in logged[0]
+    assert logged[1]["loss_train"][-1] is None  # NaN sanitized to null

@@ -120,13 +120,15 @@ GEN_PIDS=$(gen_cpu_pids)
 # but main.py does.
 PYTEST_PIDS="$(pgrep_py 'pytest') $(cpu_only "$(pgrep_py 'main\.py --config_path')")"
 # A possibly-live TPU client that we can neither pause (wedge hazard) nor
-# measure beside (contention) aborts the queue; the watcher re-fires once
-# it is gone. rc=3 is the same "not now, retry later" contract as a failed
-# probe.
+# measure beside (contention) aborts the queue; the watcher re-fires (with
+# a long back-off) once it is gone.
 if [ -f "$TPU_SEEN_FLAG" ]; then
   rm -f "$TPU_SEEN_FLAG"
   echo "=== aborting queue: possibly-live TPU client present (see above) ===" >>"$LOG"
-  exit 3
+  # rc=9 (not 3): the watcher backs off much longer for a live client than
+  # for a tunnel flap — re-firing probes every PERIOD next to a live
+  # measurement session is the contention this abort exists to avoid.
+  exit 9
 fi
 resume() {
   rm -f "$TPU_SEEN_FLAG"

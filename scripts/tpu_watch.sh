@@ -17,6 +17,11 @@ PERIOD=${PERIOD:-120}
 QUEUE_LOG=${QUEUE_LOG:-/tmp/hw_session.log}
 MAX_FIRES=${MAX_FIRES:-6}
 FIRES=0
+# Mid-queue tunnel deaths (rc=3) re-fire without counting toward MAX_FIRES;
+# this separate generous cap only bounds a runaway flap loop.
+MAX_TUNNEL_DEATHS=${MAX_TUNNEL_DEATHS:-50}
+TUNNEL_DEATHS=0
+MARKERS_SEEN=$(ls /tmp/hw_done 2>/dev/null | wc -l)
 
 # Single instance only (a second forgotten watcher would fire overlapping
 # queues; hw_session has its own lock too, but don't even race the probes).
@@ -24,8 +29,10 @@ exec 9>/tmp/tpu_watch.lock
 flock -n 9 || { echo "another tpu_watch is running; exiting"; exit 1; }
 
 # Single-shot probe (the watcher loop itself provides the retry spacing).
+# 9>&- : like every long-lived child here, the probe must not inherit the
+# lock fd (a killed watcher's orphaned probe would hold the lock ~90 s).
 probe() {
-  ATTEMPTS=1 bash scripts/tpu_probe.sh /dev/null
+  ATTEMPTS=1 bash scripts/tpu_probe.sh /dev/null 9>&-
 }
 
 while :; do
@@ -34,26 +41,55 @@ while :; do
   # core and for device acquire. flock test-and-release, no holding.
   if ! flock -n /tmp/hw_session.lock true 2>/dev/null; then
     echo "$(date -u +%FT%TZ) queue busy (hw_session.lock held)"
-    sleep "$PERIOD"
+    sleep "$PERIOD" 9>&-
     continue
   fi
   if probe; then
     echo "$(date -u +%FT%TZ) tunnel up — firing hw_session"
     # Let the probe client's claim release before the queue's first item
     # probes (>25 s release observed; same convention as hw_session run()).
-    sleep 30
+    sleep 30 9>&-
     # 9>&- : don't leak the watcher's lock fd into the queue and its
     # long-lived children — a dead watcher could then never be replaced
     # while the inherited fd held the lock.
     bash scripts/hw_session.sh "$QUEUE_LOG" 9>&-
     rc=$?
+    # rc=3: the tunnel died mid-queue (or a live client was present) — a
+    # genuine hardware event, NOT a bug in the queue. It does not count
+    # toward MAX_FIRES: round-2 observed the tunnel flapping (up ~30 s then
+    # dead), and counting flaps would exhaust the cap and leave the rest of
+    # the round unwatched. TUNNEL_DEATHS has its own generous cap purely as
+    # a runaway bound.
+    # rc=5: some item failed without a marker; could be flake (re-fire will
+    # skip completed items) or a deterministic bug — the fire cap bounds
+    # the burn in the latter case.
+    if [ "$rc" -eq 3 ] || [ "$rc" -eq 9 ]; then
+      # Progress resets the cap: in a sustained-flap regime each short
+      # window can still drain queue items (done-markers accrue), and a
+      # watcher that is making headway must not give up.
+      MARKERS=$(ls /tmp/hw_done 2>/dev/null | wc -l)
+      if [ "$MARKERS" -gt "$MARKERS_SEEN" ]; then
+        MARKERS_SEEN=$MARKERS
+        TUNNEL_DEATHS=0
+      fi
+      TUNNEL_DEATHS=$((TUNNEL_DEATHS + 1))
+      echo "$(date -u +%FT%TZ) hw_session rc=$rc (tunnel death/client $TUNNEL_DEATHS/$MAX_TUNNEL_DEATHS)"
+      if [ "$TUNNEL_DEATHS" -ge "$MAX_TUNNEL_DEATHS" ]; then
+        echo "$(date -u +%FT%TZ) tunnel-death cap reached; giving up (inspect $QUEUE_LOG)"
+        exit 7
+      fi
+      if [ "$rc" -eq 9 ]; then
+        # a live client is measuring: back off long — probing beside it
+        # every PERIOD is contention, and manual sessions run for a while
+        sleep 900 9>&-
+      else
+        sleep "$PERIOD" 9>&-
+      fi
+      continue
+    fi
     FIRES=$((FIRES + 1))
     echo "$(date -u +%FT%TZ) hw_session rc=$rc (fire $FIRES/$MAX_FIRES)"
     [ "$rc" -eq 0 ] && exit 0
-    # rc=3: tunnel died mid-queue — keep watching for the next window.
-    # rc=5: some item failed without a marker; could be flake (re-fire will
-    # skip completed items) or a deterministic bug — the fire cap below
-    # bounds the burn in the latter case.
     if [ "$FIRES" -ge "$MAX_FIRES" ]; then
       echo "$(date -u +%FT%TZ) fire cap reached; giving up (inspect $QUEUE_LOG)"
       exit 6
@@ -61,5 +97,8 @@ while :; do
   else
     echo "$(date -u +%FT%TZ) tunnel down"
   fi
-  sleep "$PERIOD"
+  # 9>&- : a sleep must not inherit the lock fd — a killed watcher would
+  # otherwise leave its orphaned sleep holding the lock for up to PERIOD,
+  # blocking the replacement watcher.
+  sleep "$PERIOD" 9>&-
 done

@@ -135,7 +135,34 @@ for log in sorted(glob.glob("logs/nbody/*/log/log.json"),
                    "best": b, "log": ld, "cfg": cfg})
 if not stages:
     raise SystemExit("no stage logs found")
-chosen = next(s for s in stages if s["exp"] == best_exp)
+chosen = next((s for s in stages if s["exp"] == best_exp), None)
+if chosen is None:
+    # best_exp came from the first-pass scan; if its log.json failed to
+    # parse here (or lies outside the glob) publishing would silently pair
+    # the wrong best/cfg with the merged curve — refuse loudly (ADVICE r3).
+    raise SystemExit(f"best run {best_exp} missing from parsed stages; "
+                     "inspect its log/log.json before publishing")
+
+
+def stage_key(cfg):
+    # Stages of ONE staged protocol differ only in the epoch budget (CLI
+    # --epochs), the resume --checkpoint, and the timestamped exp_name;
+    # anything else differing (LR, seed, data scale...) is an unrelated
+    # experiment that must not be merged into the published curve.
+    import copy
+    c = copy.deepcopy(cfg)
+    c.get("train", {}).pop("epochs", None)
+    c.get("model", {}).pop("checkpoint", None)
+    c.get("log", {}).pop("exp_name", None)
+    return json.dumps(c, sort_keys=True)
+
+
+key = stage_key(chosen["cfg"])
+skipped = [s["exp"] for s in stages if stage_key(s["cfg"]) != key]
+if skipped:
+    print(f"merge: skipping {len(skipped)} run(s) with non-matching config: "
+          f"{skipped}")
+stages = [s for s in stages if stage_key(s["cfg"]) == key]
 # Dedup EVERY per-epoch array by absolute epoch number (later stages
 # override): a crash-resume re-runs the epochs after the last eval ckpt, so
 # plain concatenation would double-count them. loss_train/epoch_time carry

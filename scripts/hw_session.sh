@@ -56,6 +56,12 @@ probe() {
 
 echo "=== hw_session $(date -u +%FT%TZ) ===" >>"$LOG"
 
+# Recover competitors a SIGKILLed bench left SIGSTOPped (shared helper;
+# ADVICE r3, medium). We hold the queue lock, so no queue-managed bench is
+# running; the helper skips if a driver-invoked bench.py is live.
+. scripts/lib_resume_paused.sh  # script already cd'd to repo root
+resume_orphaned_paused "$LOG"
+
 # Contending host processes to pause during measurement (a concurrent suite
 # degraded step timing ~4x — BASELINE.md). CRITICAL: the agent-driver
 # process embeds the whole task prompt in its command line, which contains
@@ -174,7 +180,14 @@ bench_and_check() {
   # BENCH_PROBE=0: run() already probe-gated this item and slept out the
   # claim release — bench's own probe child would just burn ~2 min of the
   # window re-proving it.
-  BENCH_PROBE=0 python bench.py | tee /tmp/bench_last.json
+  # BENCH_CALLER_PROBED attests WHAT run()'s probe verified — without it
+  # bench treats BENCH_PROBE=0 as unverified and routes results to the CPU
+  # artifact instead of stamping hardware evidence. The value comes from the
+  # probe's own jax.devices() report (tpu_probe.sh writes it), never a
+  # literal: a jax that silently fell back to CPU must attest 'cpu'
+  # (code-review r4).
+  BENCH_PROBE=0 BENCH_CALLER_PROBED="$(cat /tmp/tpu_probe.platform 2>/dev/null || echo tpu)" \
+    python bench.py | tee /tmp/bench_last.json
   # Validate AND persist: extract the single measurement JSON line (stdout
   # may carry warnings) and, if it is a real measurement, write it as a
   # tracked artifact — the driver's own end-of-round bench may land on a

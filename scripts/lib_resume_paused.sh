@@ -1,0 +1,60 @@
+# Shared recovery for competitor processes a SIGKILLed bench.py left
+# SIGSTOPped. bench.py writes the PIDs it is about to pause to
+# /tmp/bench_paused.pids BEFORE stopping them (ADVICE r3, medium): if the
+# bench is SIGKILLed (driver hard-timeout / OOM) its finally-resume never
+# runs, and without this ledger the frozen training/generation processes
+# would stall unattended work for the rest of the round.
+#
+# Single implementation sourced by BOTH scripts/tpu_watch.sh and
+# scripts/hw_session.sh — the two hand-rolled copies had already diverged
+# (one missed absolute-path interpreters) when this file was factored out.
+#
+# Caller contract: only call when no queue-managed bench can be running
+# (watcher: hw_session.lock observed free; hw_session: holds the lock).
+# NOTE: liveness scans /proc by argv, never bare pgrep -f — the
+# agent-driver's cmdline embeds 'bench.py' and matching it is the
+# session-freezing hazard (BASELINE.md).
+
+bench_py_live() {
+  local p
+  for p in /proc/[0-9]*; do
+    # interpreter may be invoked bare ('python') or by absolute path
+    # ('/usr/local/bin/python3.12') — same regex as hw_session's pgrep_py
+    tr '\0' ' ' < "$p/cmdline" 2>/dev/null \
+      | grep -Eq "^[^ ]*python[0-9.]* .*bench\.py" && return 0
+  done
+  return 1
+}
+
+proc_state() {
+  # Single-letter process state. /proc/<pid>/stat field 2 is '(comm)' and
+  # comm may contain spaces or parens, so whitespace field counting is
+  # wrong; strip through the LAST ')' (greedy sed) — same reason bench.py
+  # parses stat with split(') ').
+  sed 's/^.*) //' "/proc/$1/stat" 2>/dev/null | awk '{print $1}'
+}
+
+resume_orphaned_paused() {  # resume_orphaned_paused [logfile]
+  local f=/tmp/bench_paused.pids log=${1:-/dev/stdout} pid remaining=""
+  [ -s "$f" ] || return 0
+  bench_py_live && return 0  # a live bench's pause is intentional
+  while read -r pid; do
+    [ -n "$pid" ] || continue
+    if [ "$(proc_state "$pid")" = "T" ]; then
+      echo "$(date -u +%FT%TZ) resuming orphaned SIGSTOPped pid $pid (bench ledger)" >>"$log"
+      kill -CONT "$pid" 2>/dev/null
+    fi
+  done < "$f"
+  # Delete the ledger only once nothing it lists is still frozen — if a CONT
+  # failed (or something re-stopped a pid) the record must survive for the
+  # next recovery pass.
+  while read -r pid; do
+    [ -n "$pid" ] || continue
+    [ "$(proc_state "$pid")" = "T" ] && remaining="$remaining $pid"
+  done < "$f"
+  if [ -n "$remaining" ]; then
+    echo "$(date -u +%FT%TZ) pids still stopped after CONT:$remaining — keeping ledger" >>"$log"
+  else
+    rm -f "$f"
+  fi
+}

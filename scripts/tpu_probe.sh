@@ -13,16 +13,26 @@
 #
 # Usage: bash scripts/tpu_probe.sh [logfile]     exit 0 = up, 1 = down
 #        ATTEMPTS=1 bash scripts/tpu_probe.sh    single-shot (watcher mode)
+#
+# On success, writes the probed backend platform (tpu/cpu/...) to
+# /tmp/tpu_probe.platform so callers can attest WHAT they probed (a matmul
+# succeeding proves liveness, not platform — on a host where jax silently
+# falls back to CPU a platform-blind probe would let the queue stamp CPU
+# numbers as hardware evidence; code-review r4).
 
 set -u
 LOG=${1:-/dev/null}
 ATTEMPTS=${ATTEMPTS:-3}
 SPACING=${SPACING:-150}
+PLATFORM_FILE=${PLATFORM_FILE:-/tmp/tpu_probe.platform}
 
 try() {
   timeout 90 python -c "
 import jax, jax.numpy as jnp
-print('probe ok', float((jnp.ones((256,256))@jnp.ones((256,256))).sum()))" \
+plat = jax.devices()[0].platform
+print('probe ok', plat, float((jnp.ones((256,256))@jnp.ones((256,256))).sum()))
+open('$PLATFORM_FILE.tmp', 'w').write(plat)
+import os; os.replace('$PLATFORM_FILE.tmp', '$PLATFORM_FILE')" \
     >>"$LOG" 2>&1
 }
 

@@ -28,6 +28,15 @@ MARKERS_SEEN=$(ls /tmp/hw_done 2>/dev/null | wc -l)
 exec 9>/tmp/tpu_watch.lock
 flock -n 9 || { echo "another tpu_watch is running; exiting"; exit 1; }
 
+# Recover competitors a SIGKILLed bench left SIGSTOPped (shared helper;
+# ADVICE r3, medium). Same hw_session.lock-free guard as the in-loop call:
+# an orphaned-but-live queue may still be measuring, and CONTing heavy CPU
+# work beside it is the ~4x contention the pause exists to prevent.
+. scripts/lib_resume_paused.sh  # script already cd'd to repo root
+if flock -n /tmp/hw_session.lock true 2>/dev/null; then
+  resume_orphaned_paused
+fi
+
 # Single-shot probe (the watcher loop itself provides the retry spacing).
 # 9>&- : like every long-lived child here, the probe must not inherit the
 # lock fd (a killed watcher's orphaned probe would hold the lock ~90 s).
@@ -44,6 +53,9 @@ while :; do
     sleep "$PERIOD" 9>&-
     continue
   fi
+  # lock is free -> no queue (and no queue-managed bench) is running; safe
+  # to recover any competitors a killed direct-invoked bench left frozen
+  resume_orphaned_paused
   if probe; then
     echo "$(date -u +%FT%TZ) tunnel up — firing hw_session"
     # Let the probe client's claim release before the queue's first item

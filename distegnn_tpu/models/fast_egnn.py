@@ -61,6 +61,16 @@ class EGCLVel(nn.Module):
     # reference-shaped concat MLP (different param tree — not ckpt-compatible)
     hoist_edge_mlp: bool = True
     seg_impl: str = "scatter"  # plain-layout aggregation lowering ('scatter'|'cumsum'|'ell')
+    # one packed aggregation pass per layer (translations + edge features +
+    # count ride a single segment sum — EdgeOps.agg_rows_pair) instead of
+    # two aggregations and a count. Math-identical for scatter/ell (f32
+    # accumulation either way); cumsum differs only in prefix rounding.
+    fuse_agg: bool = True
+    # stream dtype of the packed aggregation ('bf16' halves the [E,3+H] read
+    # bytes; accumulation stays f32). bf16 ROUNDS THE COORDINATE
+    # TRANSLATIONS — equivariance becomes approximate at bf16 noise level.
+    # Measured opt-in (VERDICT r3 #1), None = f32.
+    agg_dtype: Optional[str] = None
 
     @nn.compact
     def __call__(
@@ -139,8 +149,15 @@ class EGCLVel(nn.Module):
         if self.coords_agg not in ("sum", "mean"):
             raise ValueError(f"Wrong coords_agg parameter {self.coords_agg!r}")
         trans = coord_diff * CoordMLP(H, tanh=self.tanh, name="phi_x", dtype=dt)(edge_feat)  # [B, E, 3]
-        agg = (ops.agg_rows_sum(trans) if self.coords_agg == "sum"
-               else ops.agg_rows_mean(trans))                            # [B, N, 3]
+        if self.fuse_agg and not ops.blocked:
+            # both per-layer aggregations (+ the count) in ONE pass
+            agg, agg_h_f = ops.agg_rows_pair(
+                trans, edge_feat, a_mean=(self.coords_agg == "mean"),
+                agg_dtype=self.agg_dtype)
+        else:
+            agg = (ops.agg_rows_sum(trans) if self.coords_agg == "sum"
+                   else ops.agg_rows_mean(trans))                        # [B, N, 3]
+            agg_h_f = None
         x = x + agg
 
         phi_xv = CoordMLP(H, tanh=self.tanh, name="phi_xv", dtype=dt)(vef)  # [B, N, C, 1]
@@ -156,7 +173,7 @@ class EGCLVel(nn.Module):
         X = X + global_node_mean(trans_X, node_mask, self.axis_name)     # [B, 3, C]
 
         # --- node feature update (node_model, :203-217)
-        agg_h = ops.agg_rows_mean(edge_feat)
+        agg_h = agg_h_f if agg_h_f is not None else ops.agg_rows_mean(edge_feat)
         agg_v = jnp.mean(vef, axis=2)                                    # [B, N, H]
         n_in = [h, agg_h, agg_v]
         if self.node_attr_nf:
@@ -213,6 +230,8 @@ class FastEGNN(nn.Module):
     # LargeFluid scale), so remat trades cheap recompute FLOPs for the
     # memory that bounds graph size / batch per chip (jax.checkpoint)
     remat: bool = False
+    fuse_agg: bool = True          # packed per-layer aggregation (EGCLVel)
+    agg_dtype: Optional[str] = None  # 'bf16' packed-aggregation stream (EGCLVel)
 
     @nn.compact
     def __call__(self, g: GraphBatch) -> Tuple[jnp.ndarray, jnp.ndarray]:
@@ -250,6 +269,8 @@ class FastEGNN(nn.Module):
                 compute_dtype=self.compute_dtype,
                 hoist_edge_mlp=self.hoist_edge_mlp,
                 seg_impl=self.segment_impl,
+                fuse_agg=self.fuse_agg,
+                agg_dtype=self.agg_dtype,
                 name=f"gcl_{i}",
             )(h, x, v, X, Hv, g, gravity=gravity, slot=slot, inv_deg=inv_deg,
               oh=oh)

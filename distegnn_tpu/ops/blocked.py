@@ -630,6 +630,65 @@ class EdgeOps:
     def agg_rows_sum(self, data):
         return self._agg(data, mean=False)
 
+    def agg_rows_pair(self, a, b, a_mean: bool, agg_dtype=None):
+        """Aggregate TWO edge streams in ONE pass: returns
+        (agg_sum_or_mean(a), agg_mean(b)), both float32.
+
+        The round-2 profile puts the step cost in the per-aggregation
+        scatters/prefix passes, and every EGCL layer needs exactly two row
+        aggregations (coordinate translations + edge features) plus a count.
+        Packing them as columns of a single segment sum halves the number of
+        aggregation passes per layer — for every lowering: one scatter
+        instead of two scatters + a count (op-bound path), one prefix pass
+        instead of two (bandwidth-bound cumsum path), one gather sweep
+        instead of two (ELL).
+
+        ``agg_dtype='bf16'`` casts the packed stream to bfloat16 before the
+        pass, halving the dominant [E, 3+H] read bytes; accumulation stays
+        f32 in every lowering (prefix_sum and the ELL reducer accumulate
+        f32 by construction; the scatter path scatters into an f32 output).
+        NOTE: bf16 rounds the GEOMETRY stream (a = coordinate translations),
+        trading exact-at-math-level equivariance for bandwidth — off by
+        default, a measured opt-in (VERDICT r3 #1 prepared attack).
+
+        Blocked layouts keep their two-call path (mean is a free inv_deg
+        multiply there)."""
+        if self.blocked:
+            out_a = self.agg_rows_sum(a) if not a_mean else self.agg_rows_mean(a)
+            return (out_a.astype(jnp.float32),
+                    self.agg_rows_mean(b).astype(jnp.float32))
+        g = self.g
+        B, E = b.shape[0], b.shape[1]
+        sa = a.shape[-1]
+        dt = jnp.bfloat16 if agg_dtype in ("bf16", jnp.bfloat16) else jnp.float32
+        em = g.edge_mask[..., None]
+        packed = jnp.concatenate(
+            [a.astype(dt), b.astype(dt),
+             jnp.ones((B, E, 1), dt)], axis=-1) * em.astype(dt)
+        N = g.max_nodes
+        if self.cumsum:
+            from distegnn_tpu.ops.segment import sorted_segment_sum_cs
+
+            out = jax.vmap(lambda t, r: sorted_segment_sum_cs(t, r, N).astype(
+                jnp.float32))(packed, g.row)
+        elif self.ell:
+            from distegnn_tpu.ops.segment import sorted_segment_sum_ell
+
+            D = g.max_in_degree
+            out = jax.vmap(lambda t, r: sorted_segment_sum_ell(
+                t, r, N, D).astype(jnp.float32))(packed, g.row)
+        else:
+            # f32 accumulator regardless of stream dtype (a bf16 scatter-add
+            # accumulator saturates); XLA fuses the convert into the scatter
+            # operand so the HBM read stays at stream width
+            out = jax.vmap(lambda t, r: jnp.zeros(
+                (N, t.shape[-1]), jnp.float32).at[r].add(
+                    t.astype(jnp.float32),
+                    indices_are_sorted=g.edges_sorted))(packed, g.row)
+        cnt = jnp.maximum(out[..., -1:], 1.0)
+        out_a = out[..., :sa] / cnt if a_mean else out[..., :sa]
+        return out_a, out[..., sa:-1] / cnt
+
 
 def blocked_gather(h, slot, block: int = DEFAULT_BLOCK, tile: int = DEFAULT_EDGE_TILE):
     """Batched [B, N, F] -> [B, E, F]; rows fetched block-locally (masked

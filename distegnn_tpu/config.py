@@ -105,6 +105,10 @@ _DEFAULTS: dict = {
         "backbone": True,
         "test_rot": False,
         "test_trans": False,
+        # spatial node relabeling for edge-op locality (TPU-only knob;
+        # ops/order.py): 'none' or 'morton' (Z-curve sort of positions —
+        # model-equivalent up to permutation, cache-friendly gathers)
+        "node_order": "none",
         # padding buckets (TPU-only knobs; static-shape batching):
         "node_bucket": 8,
         "edge_bucket": 128,

@@ -230,7 +230,7 @@ def run_distributed(config):
     put = global_batch_putter(mesh)
     loaders = []
     for split_idx, paths in enumerate(split_paths):
-        datasets = [GraphDataset(p) for p in paths]
+        datasets = [GraphDataset(p, node_order=d.node_order) for p in paths]
         loaders.append(_PuttingLoader(ShardedGraphLoader(
             datasets, d.batch_size, shuffle=(split_idx == 0), seed=config.seed,
             node_bucket=d.node_bucket, edge_bucket=d.edge_bucket,

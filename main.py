@@ -118,7 +118,8 @@ def main(argv=None):
 
     # Data
     files = process_dataset_edge_cutoff(config.data, seed=config.seed)
-    ds_train, ds_valid, ds_test = (GraphDataset(f) for f in files)
+    ds_train, ds_valid, ds_test = (
+        GraphDataset(f, node_order=config.data.node_order) for f in files)
     print(f"Data ready: {len(ds_train)}/{len(ds_valid)}/{len(ds_test)} graphs")
     mk = lambda ds, shuffle: GraphLoader(
         ds, config.data.batch_size, shuffle=shuffle, seed=config.seed,

@@ -1,0 +1,102 @@
+"""Spatial node ordering (ops/order.py): Morton codes, graph relabeling
+invariants, and model equivalence under the permutation."""
+
+import jax
+import numpy as np
+import pytest
+
+from distegnn_tpu.ops.graph import pad_graphs
+from distegnn_tpu.ops.order import (morton_codes, morton_perm,
+                                    morton_reorder_graph, reorder_graph)
+
+
+def _graph(rng, n=40):
+    from distegnn_tpu.data import build_nbody_graph
+
+    loc = rng.normal(size=(n, 3))
+    vel = rng.normal(size=(n, 3))
+    charges = rng.choice([1.0, -1.0], size=(n, 1))
+    return build_nbody_graph(loc, vel, charges, loc + 0.1 * vel, radius=1.2)
+
+
+def test_morton_codes_order_locality(rng):
+    """Points on a line sort by position; equal points share a code."""
+    line = np.stack([np.linspace(0, 1, 17), np.zeros(17), np.zeros(17)], 1)
+    shuffled = rng.permutation(17)
+    perm = morton_perm(line[shuffled])
+    np.testing.assert_array_equal(shuffled[perm], np.arange(17))
+    c = morton_codes(np.zeros((4, 3)))
+    assert len(set(c.tolist())) == 1
+
+
+def test_morton_neighbour_index_distance_shrinks(rng):
+    """The point of the exercise: after the Z-curve sort, radius-graph
+    neighbours are much closer in index space."""
+    from distegnn_tpu.ops.radius import radius_graph_np
+
+    loc = rng.uniform(0, 1, size=(2000, 3)).astype(np.float32)
+    ei = radius_graph_np(loc, 0.12)
+    spread_before = np.abs(ei[0] - ei[1]).mean()
+    p = morton_perm(loc)
+    ei2 = radius_graph_np(loc[p], 0.12)
+    spread_after = np.abs(ei2[0] - ei2[1]).mean()
+    assert spread_after < spread_before / 4, (spread_before, spread_after)
+
+
+def test_reorder_graph_invariants(rng):
+    g = _graph(rng)
+    perm = morton_perm(g["loc"])
+    r = reorder_graph(g, perm)
+    # node arrays permuted consistently
+    np.testing.assert_allclose(r["loc"], g["loc"][perm])
+    np.testing.assert_allclose(r["vel"], g["vel"][perm])
+    np.testing.assert_allclose(r["node_feat"], g["node_feat"][perm])
+    # edges: same edge SET under the relabeling, rows ascending
+    inv = np.empty(len(perm), np.int64)
+    inv[perm] = np.arange(len(perm))
+    orig = {(int(inv[a]), int(inv[b])) for a, b in g["edge_index"].T}
+    new = {(int(a), int(b)) for a, b in r["edge_index"].T}
+    assert orig == new
+    assert np.all(np.diff(r["edge_index"][0]) >= 0)
+    # padded batch keeps the sorted invariant (cumsum/ell eligibility)
+    assert pad_graphs([r]).edges_sorted
+
+
+def test_reorder_graph_rejects_unknown_array_key(rng):
+    g = dict(_graph(rng))
+    g["mystery"] = np.zeros((g["loc"].shape[0], 2), np.float32)
+    with pytest.raises(ValueError, match="unknown array key"):
+        reorder_graph(g, morton_perm(g["loc"]))
+
+
+def test_model_equivalent_under_reordering(rng):
+    """FastEGNN is permutation-equivariant: the reordered graph's output is
+    the permutation of the original output (so training is identical)."""
+    from distegnn_tpu.models.fast_egnn import FastEGNN
+
+    g = _graph(rng, n=32)
+    perm = morton_perm(g["loc"])
+    b0 = pad_graphs([g])
+    b1 = pad_graphs([reorder_graph(g, perm)])
+    kw = dict(node_feat_nf=2, edge_attr_nf=2, hidden_nf=16,
+              virtual_channels=3, n_layers=2)
+    params = FastEGNN(**kw).init(jax.random.PRNGKey(0), b0)
+    loc0, X0 = FastEGNN(**kw).apply(params, b0)
+    loc1, X1 = FastEGNN(**kw).apply(params, b1)
+    n = g["loc"].shape[0]
+    np.testing.assert_allclose(np.asarray(loc1)[0, :n],
+                               np.asarray(loc0)[0, :n][perm],
+                               rtol=2e-4, atol=2e-4)
+    np.testing.assert_allclose(X1, X0, rtol=2e-4, atol=2e-4)
+
+
+def test_graphdataset_node_order(rng):
+    from distegnn_tpu.data.loader import GraphDataset
+
+    graphs = [_graph(rng, n=20) for _ in range(3)]
+    ds = GraphDataset(graphs, node_order="morton")
+    assert len(ds) == 3
+    codes = morton_codes(ds[0]["loc"])
+    assert np.all(np.diff(codes.astype(np.int64)) >= 0)
+    with pytest.raises(ValueError, match="node_order"):
+        GraphDataset(graphs, node_order="hilbert")

@@ -149,8 +149,9 @@ class EGCLVel(nn.Module):
         if self.coords_agg not in ("sum", "mean"):
             raise ValueError(f"Wrong coords_agg parameter {self.coords_agg!r}")
         trans = coord_diff * CoordMLP(H, tanh=self.tanh, name="phi_x", dtype=dt)(edge_feat)  # [B, E, 3]
-        if self.fuse_agg and not ops.blocked:
-            # both per-layer aggregations (+ the count) in ONE pass
+        if self.fuse_agg:
+            # both per-layer aggregations (+ the count) in ONE pass (blocked
+            # layouts keep two calls inside but honor the agg_dtype knob)
             agg, agg_h_f = ops.agg_rows_pair(
                 trans, edge_feat, a_mean=(self.coords_agg == "mean"),
                 agg_dtype=self.agg_dtype)

@@ -654,6 +654,13 @@ class EdgeOps:
         Blocked layouts keep their two-call path (mean is a free inv_deg
         multiply there)."""
         if self.blocked:
+            # two-call path (mean is a free inv_deg multiply here), but the
+            # stream-dtype knob still applies: bf16 operands run the one-hot
+            # kernels single-pass instead of f32 precision=HIGHEST 6-pass —
+            # the gen-2 blocked configuration (VERDICT r3 #1)
+            if agg_dtype in ("bf16", jnp.bfloat16):
+                a = a.astype(jnp.bfloat16)
+                b = b.astype(jnp.bfloat16)
             out_a = self.agg_rows_sum(a) if not a_mean else self.agg_rows_mean(a)
             return (out_a.astype(jnp.float32),
                     self.agg_rows_mean(b).astype(jnp.float32))

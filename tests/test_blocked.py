@@ -300,3 +300,37 @@ def test_other_fast_models_blocked_parity(model_name, blocked_impl):
     gb = ravel_pytree(jax.grad(loss)(params, blocked))[0]
     scale = jnp.maximum(jnp.abs(gp).max(), 1.0)
     np.testing.assert_allclose(gb / scale, gp / scale, atol=5e-5)
+
+
+def test_gen2_shapes_big_tile_small_scale():
+    """Gen-2 kernel configuration (block 512 x tile 2048, bf16 streams)
+    scaled down to interpret-mode size: block > tile-disproportionate shapes
+    and the bf16 single-pass path stay exact vs the scatter reference."""
+    rng = np.random.default_rng(7)
+    n_nodes, block, tile = 256, 64, 128
+    e = 1500
+    row = np.sort(rng.integers(0, n_nodes, e)).astype(np.int64)
+    col = rng.integers(0, n_nodes, e).astype(np.int64)
+    epb = -(-max_block_degree(row, n_nodes, block) // tile) * tile
+    ei, _, em = blockify_edges(np.stack([row, col]), None, n_nodes, epb, block)
+    slots = slot_ids(jnp.asarray(ei[0])[None], jnp.asarray(em)[None], block, epb)
+    E = ei.shape[1]
+    data = np.zeros((E, 8), np.float32)
+    data[em > 0] = rng.normal(size=(e, 8)).astype(np.float32)
+    db = jnp.asarray(data).astype(jnp.bfloat16)
+
+    out = blocked_segment_sum(db[None], slots, n_nodes, block, tile)[0]
+    ref = segment_sum(db.astype(jnp.float32), jnp.asarray(ei[0]), n_nodes,
+                      mask=jnp.asarray(em))
+    # bf16 inputs, f32 accumulation: error is input-rounding level only
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-2, atol=2e-2)
+
+    h = jnp.asarray(rng.normal(size=(n_nodes, 8)).astype(np.float32)).astype(
+        jnp.bfloat16)
+    g_out = blocked_gather(h[None], slots, block, tile)[0]
+    ref_g = jnp.where(jnp.asarray(em)[:, None] > 0,
+                      jnp.take(h, jnp.asarray(ei[0]), axis=0), 0)
+    np.testing.assert_allclose(
+        np.asarray(g_out, np.float32),
+        np.asarray(jnp.asarray(ref_g, jnp.float32)), rtol=0, atol=0)

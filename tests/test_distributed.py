@@ -261,10 +261,13 @@ def test_distributed_cumsum_matches_scatter(dist_setup):
 
 
 def test_metis_partition_quality_pinned():
-    """Pin the native metis-standin's quality on a Fluid113K-like cloud
-    (VERDICT r2 next-round #5): cut within 1.5x of kmeans (the best measured
-    method at 20k/113k scale — docs/PERFORMANCE.md table), near-balanced
-    parts. Guards regressions in native/partition.cpp refinement."""
+    """Pin the native multilevel partitioner's quality on a Fluid113K-like
+    cloud (VERDICT r2 #5 / r3 #5): since the round-4 multilevel rewrite
+    (HEM coarsening + weighted FM + k-way uncoarsening refinement +
+    coarsest restarts) metis BEATS kmeans at 113k/8-way (cut 0.0298 vs
+    0.0360, docs/artifacts/partition_quality_113k_r4.json); at this test's
+    reduced 5k scale allow parity-with-margin. Guards regressions in
+    native/partition.cpp."""
     import scripts.partition_quality as pq
     from distegnn_tpu.ops.radius import radius_graph_np
 
@@ -274,6 +277,6 @@ def test_metis_partition_quality_pinned():
     for method in ("random", "kmeans", "metis"):
         labels = assign_partitions(loc, 8, method, outer_radius=pq.RADIUS, seed=0)
         q[method] = pq.quality(labels, edge_index, 8)
-    assert q["metis"]["cut_fraction"] <= 1.5 * q["kmeans"]["cut_fraction"]
-    assert q["metis"]["cut_fraction"] <= 0.3 * q["random"]["cut_fraction"]
-    assert q["metis"]["node_imbalance"] <= 1.1
+    assert q["metis"]["cut_fraction"] <= 1.15 * q["kmeans"]["cut_fraction"]
+    assert q["metis"]["cut_fraction"] <= 0.25 * q["random"]["cut_fraction"]
+    assert q["metis"]["node_imbalance"] <= 1.05

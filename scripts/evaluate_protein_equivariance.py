@@ -1,0 +1,95 @@
+"""Protein test_rot / test_trans equivariance evaluation (VERDICT r3 #6).
+
+The reference evaluates empirical E(3)-equivariance by REBUILDING the test
+split with a random rotation (test_rot) or a box-scaled translation
+(test_trans) injected into every frame (reference
+datasets/process_dataset.py:162-174) and reporting test MSE on each variant.
+An equivariant model scores the same MSE on all three (up to float noise);
+a non-equivariant one degrades under the injection.
+
+This script loads a trained checkpoint and reports the test MSE triple:
+
+  python scripts/evaluate_protein_equivariance.py \
+      --config_path configs/protein_cpu_slice.yaml \
+      --checkpoint logs/protein_cpu_slice/<exp>/state_dict/best_model.ckpt \
+      [--json out.json]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+
+import jax
+import numpy as np
+
+from distegnn_tpu.config import derive_runtime_fields, load_config
+from distegnn_tpu.data import GraphDataset, GraphLoader
+from distegnn_tpu.data.protein import process_protein_cutoff
+from distegnn_tpu.models.registry import get_model
+from distegnn_tpu.train import make_eval_step, restore_params
+from distegnn_tpu.utils.seed import fix_seed
+
+
+def test_mse(config, model, params, eval_step, variant: str) -> float:
+    d = config.data
+    paths = process_protein_cutoff(
+        d.data_dir, d.dataset_name, d.max_samples, d.radius, d.delta_t,
+        d.cutoff_rate, backbone=d.backbone,
+        test_rot=(variant == "rot"), test_trans=(variant == "trans"),
+        seed=config.seed)
+    ds_test = GraphDataset(paths[2], node_order=d.node_order)
+    loader = GraphLoader(ds_test, d.batch_size, shuffle=False,
+                         seed=config.seed, node_bucket=d.node_bucket,
+                         edge_bucket=d.edge_bucket)
+    num, den = 0.0, 0.0
+    for batch in loader:
+        # node-weighted global MSE, accumulated the way the trainer does
+        n_nodes = float(np.asarray(batch.node_mask).sum())
+        num += float(eval_step(params, batch)) * n_nodes
+        den += n_nodes
+    return num / max(den, 1.0)
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--config_path", required=True)
+    ap.add_argument("--checkpoint", required=True)
+    ap.add_argument("--json", default=None)
+    args = ap.parse_args()
+
+    config = load_config(args.config_path)
+    derive_runtime_fields(config, world_size=1)
+    fix_seed(config.seed)
+
+    model = get_model(config.model, world_size=1,
+                      dataset_name=config.data.dataset_name)
+    # init against one plain test batch to get the param structure
+    d = config.data
+    paths = process_protein_cutoff(
+        d.data_dir, d.dataset_name, d.max_samples, d.radius, d.delta_t,
+        d.cutoff_rate, backbone=d.backbone, seed=config.seed)
+    ds = GraphDataset(paths[2], node_order=d.node_order)
+    loader = GraphLoader(ds, d.batch_size, shuffle=False, seed=config.seed,
+                         node_bucket=d.node_bucket, edge_bucket=d.edge_bucket)
+    params = model.init(jax.random.PRNGKey(config.seed), next(iter(loader)))
+    params = restore_params(args.checkpoint, params)
+    eval_step = jax.jit(make_eval_step(model))
+
+    out = {"checkpoint": args.checkpoint}
+    for variant in ("plain", "rot", "trans"):
+        out[f"test_mse_{variant}"] = test_mse(config, model, params,
+                                              eval_step, variant)
+        print(f"test MSE ({variant}):  {out[f'test_mse_{variant}']:.6f}")
+    rel = max(abs(out["test_mse_rot"] - out["test_mse_plain"]),
+              abs(out["test_mse_trans"] - out["test_mse_plain"]))
+    out["max_abs_deviation"] = rel
+    print(f"max |deviation| vs plain: {rel:.2e} "
+          f"({'equivariant' if rel < 0.05 * out['test_mse_plain'] + 1e-6 else 'DEGRADED'})")
+    if args.json:
+        with open(args.json, "w") as f:
+            json.dump(out, f, indent=1)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,148 @@
+"""SE(3)/TFN golden cross-checks against independent references (VERDICT r3
+#7): (1) our real spherical harmonics vs scipy's complex ones through the
+textbook real-complex relation — anchoring the convention every downstream
+object (Wigner-D, Q_J, kernel bases) is derived from; (2) a host-numpy
+reimplementation of the reference GConvSE3 computation path
+(modules.py:82-190 + PairwiseConv 232-265: radial MLP -> per-J kernel
+assembly -> block matmul -> neighbor mean) checked against our fused-einsum
+layer with the same weights. The BN->LayerNorm swap (documented in
+models/se3/tfn.py) is mirrored here, leaving it the only divergence from
+the reference math."""
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+import jax.numpy as jnp  # noqa: E402
+
+from distegnn_tpu.models.se3.basis import compute_basis_and_r  # noqa: E402
+from distegnn_tpu.models.se3.fibers import Fiber  # noqa: E402
+from distegnn_tpu.models.se3.so3 import real_sph_harm  # noqa: E402
+from distegnn_tpu.models.se3.tfn import GConvSE3  # noqa: E402
+from distegnn_tpu.ops.graph import pad_graphs  # noqa: E402
+
+
+def _scipy_sph_harm(m, l, theta, phi):
+    """Complex Y_l^m (Condon-Shortley), polar angle theta, azimuth phi —
+    across the scipy 1.15 API rename."""
+    import scipy.special as sp
+
+    if hasattr(sp, "sph_harm_y"):
+        return sp.sph_harm_y(l, m, theta, phi)
+    return sp.sph_harm(m, l, phi, theta)
+
+
+def test_real_sph_harm_matches_scipy():
+    """Our tesseral harmonics equal the textbook real combination of scipy's
+    complex CS-phased harmonics:
+      m=0:  Y_l^0
+      m>0 (cos type):  sqrt(2) (-1)^m Re Y_l^m
+      m<0 (sin type):  sqrt(2) (-1)^|m| Im Y_l^|m|
+    for l = 0..4 over random directions."""
+    rng = np.random.default_rng(0)
+    v = rng.normal(size=(50, 3))
+    v /= np.linalg.norm(v, axis=1, keepdims=True)
+    theta = np.arccos(np.clip(v[:, 2], -1, 1))
+    phi = np.arctan2(v[:, 1], v[:, 0])
+    for l in range(5):
+        ours = real_sph_harm(l, v)                       # [50, 2l+1], m=-l..l
+        for m in range(-l, l + 1):
+            am = abs(m)
+            Y = _scipy_sph_harm(am, l, theta, phi)
+            if m == 0:
+                ref = Y.real
+            elif m > 0:
+                ref = np.sqrt(2.0) * (-1.0) ** m * Y.real
+            else:
+                ref = np.sqrt(2.0) * (-1.0) ** am * Y.imag
+            np.testing.assert_allclose(ours[:, m + l], ref, atol=1e-10,
+                                       err_msg=f"l={l} m={m}")
+
+
+def _tiny_graph(rng, n=6):
+    from distegnn_tpu.data import build_nbody_graph
+
+    loc = rng.normal(size=(n, 3))
+    vel = rng.normal(size=(n, 3))
+    charges = rng.choice([1.0, -1.0], size=(n, 1))
+    return pad_graphs([build_nbody_graph(loc, vel, charges, loc, radius=-1.0)])
+
+
+def _np_layernorm(x, scale, bias, eps=1e-6):
+    mu = x.mean(axis=-1, keepdims=True)
+    var = ((x - mu) ** 2).mean(axis=-1, keepdims=True)
+    return (x - mu) / np.sqrt(var + eps) * scale + bias
+
+
+def _np_radial(params, feat, num_freq, m_in, m_out):
+    """Reference RadialFunc (modules.py:193-230), BN->LayerNorm, in numpy."""
+    y = feat @ np.asarray(params["Dense_0"]["kernel"]) + np.asarray(params["Dense_0"]["bias"])
+    y = np.maximum(_np_layernorm(y, np.asarray(params["LayerNorm_0"]["scale"]),
+                                 np.asarray(params["LayerNorm_0"]["bias"])), 0)
+    y = y @ np.asarray(params["Dense_1"]["kernel"]) + np.asarray(params["Dense_1"]["bias"])
+    y = np.maximum(_np_layernorm(y, np.asarray(params["LayerNorm_1"]["scale"]),
+                                 np.asarray(params["LayerNorm_1"]["bias"])), 0)
+    y = y @ np.asarray(params["Dense_2"]["kernel"]) + np.asarray(params["Dense_2"]["bias"])
+    return y.reshape(y.shape[:-1] + (m_out, m_in, num_freq))
+
+
+def test_gconv_matches_numpy_reference(rng):
+    """Reference-shaped GConvSE3 forward in plain numpy — per-edge kernel
+    matrices assembled exactly as PairwiseConv does (kernel[o*(2do+1),
+    i*(2di+1)] = sum_f R[o,i,f] basis[p,q,f]), block matvec per edge, then
+    per-destination mean — equals our fused einsum layer."""
+    g = _tiny_graph(rng)
+    f_in = Fiber(dictionary={0: 2, 1: 1})
+    f_out = Fiber(dictionary={0: 1, 1: 2, 2: 1})
+    B, N = g.loc.shape[:2]
+    h = {0: jnp.asarray(rng.standard_normal((B, N, 2, 1)).astype(np.float32)),
+         1: jnp.asarray(rng.standard_normal((B, N, 1, 3)).astype(np.float32))}
+
+    layer = GConvSE3(f_in, f_out, self_interaction=True, edge_dim=2)
+    rel = (np.take_along_axis(np.asarray(g.loc), np.asarray(g.row)[..., None], 1)
+           - np.take_along_axis(np.asarray(g.loc), np.asarray(g.col)[..., None], 1))
+    basis, r = compute_basis_and_r(jnp.asarray(rel), 2)
+    params = layer.init(jax.random.PRNGKey(0), h, g, r, basis)
+    out = layer.apply(params, h, g, r, basis)
+
+    # ---- numpy golden ----
+    p = jax.tree.map(np.asarray, params)["params"]
+    row = np.asarray(g.row)[0]
+    col = np.asarray(g.col)[0]
+    em = np.asarray(g.edge_mask)[0]
+    E = row.shape[0]
+    feat = np.concatenate([np.asarray(g.edge_attr)[0], np.asarray(r)[0]], -1)
+    h_np = {d: np.asarray(h[d])[0] for d in (0, 1)}
+    basis_np = {k: np.asarray(v)[0] for k, v in basis.items()}
+
+    for m_out, d_out in f_out.structure:
+        msg = np.zeros((E, m_out, 2 * d_out + 1))
+        for m_in, d_in in f_in.structure:
+            R = _np_radial(p[f"radial_{d_in}_{d_out}"], feat,
+                           2 * min(d_in, d_out) + 1, m_in, m_out)
+            K = basis_np[(d_in, d_out)]              # [E, 2do+1, 2di+1, nf]
+            for e in range(E):
+                # reference PairwiseConv: the full block kernel matrix
+                kernel = np.zeros((m_out * (2 * d_out + 1),
+                                   m_in * (2 * d_in + 1)))
+                for o in range(m_out):
+                    for i in range(m_in):
+                        blk = (R[e, o, i, :] * K[e]).sum(axis=-1)
+                        kernel[o * (2 * d_out + 1):(o + 1) * (2 * d_out + 1),
+                               i * (2 * d_in + 1):(i + 1) * (2 * d_in + 1)] = blk
+                src = h_np[d_in][col[e]].reshape(-1)
+                msg[e] += (kernel @ src).reshape(m_out, 2 * d_out + 1)
+        if d_out in f_in.structure_dict:
+            W = p[f"self_{d_out}"]
+            for e in range(E):
+                dst = h_np[d_out][row[e]]            # [m_in, 2d+1]
+                msg[e] += W @ dst
+        # per-destination masked mean (reference fn.mean over in-edges)
+        agg = np.zeros((N, m_out, 2 * d_out + 1))
+        for n in range(N):
+            sel = (row == n) & (em > 0)
+            if sel.any():
+                agg[n] = msg[sel].mean(axis=0)
+        np.testing.assert_allclose(np.asarray(out[d_out])[0], agg,
+                                   rtol=2e-4, atol=2e-4,
+                                   err_msg=f"degree {d_out}")

@@ -259,6 +259,35 @@ run microbench_segsum_bf16 python scripts/microbench_segsum.py --bf16
 run profile_cumsum python scripts/profile_step.py --bf16 --seg cumsum
 run profile_plain python scripts/profile_step.py --bf16
 
+# 3b. one real LargeFluid epoch on chip, end to end (VERDICT r3 #3): the
+#     flagship largefluid_distegnn.yaml through main.py — 113,140 nodes,
+#     metis partition shards, grad accum 4, MMD, remat, distribute mode.
+#     Data: the synthetic Fluid113K-format generator at full particle count
+#     (honestly labeled — real bytes are egress-blocked; format and scale
+#     are authentic). Validates scan policy + remat headroom at scale and
+#     logs per-epoch time_cost.
+largefluid_epoch_and_check() {
+  if ! ls data/LargeFluid/Fluid113K/sim_0001_*.msgpack.zst >/dev/null 2>&1; then
+    nice -n 5 env PYTHONPATH=/root/repo JAX_PLATFORMS=cpu \
+      python scripts/generate_fluid_synthetic.py --out data/LargeFluid \
+      --particles 113140 --frames 28 --sims-train 1 --sims-valid 1 \
+      --sims-test 1 || return 1
+  fi
+  python -u main.py --config_path configs/largefluid_distegnn.yaml \
+    --epochs 1 2>&1 | tee /tmp/largefluid_epoch.log
+  L=$(ls -t logs/largefluid/*/log/log.json 2>/dev/null | head -1) || return 1
+  [ -n "$L" ] || return 1
+  mkdir -p docs/artifacts
+  cp "$L" docs/artifacts/largefluid_epoch_log.json
+}
+run largefluid_epoch largefluid_epoch_and_check
+
+# 3c. remat memory on the REAL backend: XLA:CPU provably discards
+#     rematerialization in buffer assignment (docs/PERFORMANCE.md), so the
+#     compiled-temp comparison only means something here.
+run remat_xla_temp python scripts/measure_remat_memory.py --nodes 113140 \
+  --xla-temp --json docs/artifacts/remat_memory_tpu.json
+
 # 4. convergence in STAGES: at ~15 s/epoch on-chip the full 2500-epoch
 #    protocol is ~10 h — longer than any observed tunnel window. Each stage
 #    resumes from the previous stage's last_model.ckpt and captures

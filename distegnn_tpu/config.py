@@ -84,6 +84,13 @@ _DEFAULTS: dict = {
         # 'cumsum' = scatter-free prefix-sum differences (f32-rounded),
         # 'ell' = scatter-free fixed-degree gathers (exact).
         "segment_impl": "scatter",
+        # one packed aggregation pass per EGCL layer (translations + edge
+        # features + count in a single segment sum; EdgeOps.agg_rows_pair)
+        "fuse_agg": True,
+        # packed-aggregation stream dtype: null (f32) or 'bf16' (halves the
+        # dominant read bytes; f32 accumulation; rounds geometry columns —
+        # measured opt-in, see docs/PERFORMANCE.md round-4 attack)
+        "agg_dtype": None,
     },
     "data": {
         "data_dir": "./data",

@@ -53,6 +53,11 @@ class SchNetGCLVel(nn.Module):
     epsilon: float = 1e-8
     hoist_edge_mlp: bool = True  # phi_e + gate first Dense on the node axis
     seg_impl: str = "scatter"
+    # one packed aggregation pass for the layer's two row aggregations
+    # (coordinate update + edge features; EdgeOps.agg_rows_pair — the same
+    # fusion FastEGNN applies)
+    fuse_agg: bool = True
+    agg_dtype: Optional[str] = None
 
     @nn.compact
     def __call__(self, h, x, v, X, Hv, g: GraphBatch, gravity=None,
@@ -119,7 +124,13 @@ class SchNetGCLVel(nn.Module):
         else:
             gate = TorchDense(1, name="schnet_coord_update")(
                 jnp.concatenate([gauss, h_row, h_col], axis=-1))
-        x = x + ops.agg_rows_mean(raw_diff * gate)
+        if self.fuse_agg:
+            agg_x, agg_h_f = ops.agg_rows_pair(
+                raw_diff * gate, edge_feat, a_mean=True,
+                agg_dtype=self.agg_dtype)
+        else:
+            agg_x, agg_h_f = ops.agg_rows_mean(raw_diff * gate), None
+        x = x + agg_x
 
         # virtual pull on real nodes (phi_xv / coord_mlp_r_virtual)
         phi_xv = CoordMLP(H, tanh=self.tanh, name="phi_xv")(vef)
@@ -133,7 +144,7 @@ class SchNetGCLVel(nn.Module):
         X = X + global_node_mean(trans_X, node_mask, self.axis_name)
 
         # feature updates phi_h / phi_hv (FastSchNet.py:140-166)
-        agg_h = ops.agg_rows_mean(edge_feat)
+        agg_h = agg_h_f if agg_h_f is not None else ops.agg_rows_mean(edge_feat)
         agg_v = jnp.mean(vef, axis=2)
         n_in = [h, agg_h, agg_v]
         if self.node_attr_nf:
@@ -168,6 +179,8 @@ class FastSchNet(nn.Module):
     blocked_impl: str = "einsum"  # blocked-layout edge-op lowering ('pallas'|'einsum')
     hoist_edge_mlp: bool = True   # phi_e + gate first Dense on the node axis
     segment_impl: str = "scatter"  # plain-layout lowering ('scatter'|'cumsum'|'ell')
+    fuse_agg: bool = True          # packed per-layer aggregation (SchNetGCLVel)
+    agg_dtype: Optional[str] = None
 
     @nn.compact
     def __call__(self, g: GraphBatch) -> Tuple[jnp.ndarray, jnp.ndarray]:
@@ -194,6 +207,8 @@ class FastSchNet(nn.Module):
                 tanh=self.tanh, has_gravity=self.gravity is not None,
                 axis_name=self.axis_name, hoist_edge_mlp=self.hoist_edge_mlp,
                 seg_impl=self.segment_impl,
+                fuse_agg=self.fuse_agg,
+                agg_dtype=self.agg_dtype,
                 name=f"gcl_{i}",
             )(h, x, v, X, Hv, g, gravity=gravity, slot=slot, inv_deg=inv_deg,
               oh=oh)

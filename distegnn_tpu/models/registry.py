@@ -84,6 +84,8 @@ def get_model(model_config, world_size: int = 1, dataset_name: Optional[str] = N
                 blocked_impl=model_config.get("blocked_impl", "einsum"),
                 hoist_edge_mlp=bool(model_config.get("hoist_edge_mlp", True)),
                 segment_impl=model_config.get("segment_impl", "scatter"),
+                fuse_agg=bool(model_config.get("fuse_agg", True)),
+                agg_dtype=model_config.get("agg_dtype"),
             )
         SchNet = _import_model("schnet", "SchNet")
         return SchNet(hidden_channels=model_config.hidden_nf, cutoff=cutoff)

@@ -125,3 +125,20 @@ def test_fastegnn_blocked_batch_ignores_fuse(rng):
     out_f = FastEGNN(**kw, fuse_agg=True).apply(params, g)
     out_u = FastEGNN(**kw, fuse_agg=False).apply(params, g)
     np.testing.assert_allclose(out_f[0], out_u[0], atol=0, rtol=0)
+
+
+@pytest.mark.parametrize("seg", ["scatter", "cumsum"])
+def test_fastschnet_fuse_agg_parity(batch, rng, seg):
+    """FastSchNet applies the same per-layer aggregation fusion."""
+    from distegnn_tpu.models.fast_schnet import FastSchNet
+
+    g = batch
+    kw = dict(node_feat_nf=2, edge_attr_nf=2, hidden_nf=16, virtual_channels=3,
+              n_layers=2, segment_impl=seg)
+    m_f = FastSchNet(**kw)
+    m_u = FastSchNet(**kw, fuse_agg=False)
+    params = m_f.init(jax.random.PRNGKey(0), g)
+    out_f = m_f.apply(params, g)
+    out_u = m_u.apply(params, g)
+    np.testing.assert_allclose(out_f[0], out_u[0], rtol=1e-5, atol=5e-5)
+    np.testing.assert_allclose(out_f[1], out_u[1], rtol=1e-5, atol=5e-5)

@@ -27,7 +27,8 @@ from distegnn_tpu.config import derive_runtime_fields, load_config
 from distegnn_tpu.data import GraphDataset, GraphLoader
 from distegnn_tpu.data.protein import process_protein_cutoff
 from distegnn_tpu.models.registry import get_model
-from distegnn_tpu.train import make_eval_step, restore_params
+from distegnn_tpu.train import make_eval_step
+from distegnn_tpu.train.checkpoint import restore_params
 from distegnn_tpu.utils.seed import fix_seed
 
 

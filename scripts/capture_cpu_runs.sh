@@ -1,0 +1,47 @@
+#!/usr/bin/env bash
+# Capture committed-able artifacts from the round-4 CPU evidence runs:
+#   - 1000-sample nbody convergence (configs/nbody_cpu_1000.yaml)
+#   - bounded protein run + test_rot/test_trans equivariance triple
+# Idempotent; safe to run at any time (snapshots whatever exists now).
+# Heavy extras (rollout eval) are opt-in flags so a snapshot stays cheap.
+#
+# Usage: bash scripts/capture_cpu_runs.sh [--rollout]
+
+set -u
+cd "$(dirname "$0")/.."
+mkdir -p docs/artifacts
+
+snap() {  # snap <glob> <dest>
+  local src
+  src=$(ls -t $1 2>/dev/null | head -1)
+  [ -n "$src" ] || { echo "skip: no match for $1"; return 0; }
+  cp "$src" "$2.tmp" && mv "$2.tmp" "$2" && echo "captured $2 (from $src)"
+}
+
+snap "logs/nbody_cpu_1000/*/log/log.json" docs/artifacts/nbody1000_cpu_log.json
+snap "logs/protein_cpu_slice/*/log/log.json" docs/artifacts/protein_cpu_slice_log.json
+snap "logs/nbody_cpu_slice/*/log/log.json" docs/artifacts/nbody100_cpu_slice_log.json
+
+# protein equivariance triple (cheap: 3 x 12 eval batches; pkl cache hits
+# after the first run)
+CKPT=$(ls -t logs/protein_cpu_slice/*/state_dict/best_model.ckpt 2>/dev/null | head -1)
+if [ -n "$CKPT" ]; then
+  env PYTHONPATH=/root/repo JAX_PLATFORMS=cpu python \
+    scripts/evaluate_protein_equivariance.py \
+    --config_path configs/protein_cpu_slice.yaml --checkpoint "$CKPT" \
+    --json docs/artifacts/protein_equivariance_triple.json \
+    && echo "captured protein_equivariance_triple.json"
+fi
+
+if [ "${1:-}" = "--rollout" ]; then
+  CKPT=$(ls -t logs/nbody_cpu_1000/*/state_dict/best_model.ckpt 2>/dev/null | head -1)
+  if [ -n "$CKPT" ]; then
+    env PYTHONPATH=/root/repo JAX_PLATFORMS=cpu python \
+      scripts/evaluate_rollout.py --config_path configs/nbody_cpu_1000.yaml \
+      --checkpoint "$CKPT" --samples 200 \
+      > docs/artifacts/nbody1000_cpu_rollout_mse.json.tmp \
+      && mv docs/artifacts/nbody1000_cpu_rollout_mse.json.tmp \
+            docs/artifacts/nbody1000_cpu_rollout_mse.json \
+      && echo "captured nbody1000_cpu_rollout_mse.json"
+  fi
+fi

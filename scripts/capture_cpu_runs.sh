@@ -21,6 +21,7 @@ snap() {  # snap <glob> <dest>
 snap "logs/nbody_cpu_1000/*/log/log.json" docs/artifacts/nbody1000_cpu_log.json
 snap "logs/protein_cpu_slice/*/log/log.json" docs/artifacts/protein_cpu_slice_log.json
 snap "logs/nbody_cpu_slice/*/log/log.json" docs/artifacts/nbody100_cpu_slice_log.json
+snap "logs/water3d_cpu_slice/*/log/log.json" docs/artifacts/water3d_cpu_slice_log.json
 
 # protein equivariance triple (cheap: 3 x 12 eval batches; pkl cache hits
 # after the first run)

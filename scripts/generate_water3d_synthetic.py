@@ -1,0 +1,73 @@
+"""Synthetic Water-3D raw h5 generator (bounded e2e evidence runs).
+
+The real Water-3D dataset is DeepMind's learning-to-simulate water scenes,
+converted from tfrecord to h5 by scripts/water3d_tfrecord_to_h5.py
+(format validated on authentic tf.train.SequenceExample bytes in
+tests/test_water3d_tfrecord.py) — the bytes themselves are egress-blocked
+in this container. This script writes the SAME h5 layout
+(traj_<k>/position [T,N,3] + particle_type [N]) with the damped pseudo-SPH
+dynamic of scripts/generate_fluid_synthetic.py at Water-3D edge density, so
+the full cutoff pipeline (h5 -> per-frame graphs -> training) runs end to
+end and leaves a loss-curve artifact. NOT physical water — pipeline and
+training-behavior evidence only; swap in the converted real h5 for accuracy
+work (docs/DATASETS.md).
+
+Usage: python scripts/generate_water3d_synthetic.py --out data/simulate \
+           [--particles 2000] [--frames 45] [--trajs 4]
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+
+import numpy as np
+
+
+def synth_traj(rng: np.random.Generator, n: int, frames: int, radius: float):
+    """Damped falling-particle cloud at ~15 neighbors within ``radius``."""
+    vol = n * (4.0 / 3.0) * np.pi * radius**3 / 15.0
+    side = vol ** (1.0 / 3.0)
+    pos = rng.uniform(0, side, size=(n, 3)).astype(np.float32)
+    vel = rng.normal(size=(n, 3)).astype(np.float32) * 0.002
+    g = np.array([0.0, 0.0, -0.05], np.float32)
+    poss = []
+    for _ in range(frames):
+        vel = 0.99 * vel + g * 0.002 + rng.normal(size=(n, 3)).astype(np.float32) * 2e-4
+        pos = pos + vel * 0.01
+        under, over = pos < 0, pos > side
+        vel = np.where(under | over, -0.5 * vel, vel)
+        pos = np.clip(pos, 0, side)
+        poss.append(pos.copy())
+    return np.stack(poss)
+
+
+def main() -> None:
+    import h5py
+
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--out", default="data/simulate")
+    ap.add_argument("--particles", type=int, default=2000)
+    ap.add_argument("--frames", type=int, default=45)
+    ap.add_argument("--trajs", type=int, default=4)
+    ap.add_argument("--radius", type=float, default=0.035,
+                    help="density target (reference water3d radius)")
+    ap.add_argument("--seed", type=int, default=0)
+    args = ap.parse_args()
+
+    rng = np.random.default_rng(args.seed)
+    base = os.path.join(args.out, "Water-3D")
+    os.makedirs(base, exist_ok=True)
+    for split in ("train", "valid", "test"):
+        with h5py.File(os.path.join(base, f"{split}.h5"), "w") as f:
+            for k in range(args.trajs):
+                g = f.create_group(f"traj_{k}")
+                g["particle_type"] = np.full((args.particles,), 5.0)
+                g["position"] = synth_traj(rng, args.particles, args.frames,
+                                           args.radius)
+        print(f"wrote {split}.h5: {args.trajs} trajs x [{args.frames}, "
+              f"{args.particles}, 3]")
+
+
+if __name__ == "__main__":
+    main()

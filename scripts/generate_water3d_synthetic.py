@@ -29,12 +29,16 @@ def synth_traj(rng: np.random.Generator, n: int, frames: int, radius: float):
     vol = n * (4.0 / 3.0) * np.pi * radius**3 / 15.0
     side = vol ** (1.0 / 3.0)
     pos = rng.uniform(0, side, size=(n, 3)).astype(np.float32)
-    vel = rng.normal(size=(n, 3)).astype(np.float32) * 0.002
+    # motion scaled so the delta_t=20 target displacement is a meaningful
+    # fraction of the neighbourhood radius (~0.01-0.02 vs side ~0.29): a
+    # first cut with ~100x weaker dynamics made the prediction task trivial
+    # (loss floor 2e-7 by epoch 4 — no learning curve to show)
+    vel = rng.normal(size=(n, 3)).astype(np.float32) * 0.02
     g = np.array([0.0, 0.0, -0.05], np.float32)
     poss = []
     for _ in range(frames):
-        vel = 0.99 * vel + g * 0.002 + rng.normal(size=(n, 3)).astype(np.float32) * 2e-4
-        pos = pos + vel * 0.01
+        vel = 0.99 * vel + g * 0.01 + rng.normal(size=(n, 3)).astype(np.float32) * 2e-3
+        pos = pos + vel * 0.02
         under, over = pos < 0, pos > side
         vel = np.where(under | over, -0.5 * vel, vel)
         pos = np.clip(pos, 0, side)

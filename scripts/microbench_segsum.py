@@ -123,6 +123,22 @@ def main():
         err = np.abs(np.asarray(fn(*args)) - ref).max()
         print(f"max|{name} - scatter| = {err:.3e}")
 
+    # fused per-layer aggregation (EdgeOps.agg_rows_pair): the model's two
+    # per-layer aggregations + mean count as ONE packed pass vs three
+    # separate passes — the round-4 fuse_agg attack, isolated
+    x3 = jnp.asarray(rng.normal(size=(E, 3)).astype(np.float32)).astype(dt)
+    f_three = jax.jit(lambda a, b, i: (
+        jnp.zeros((N, 3), jnp.float32).at[i].add(
+            a.astype(jnp.float32), indices_are_sorted=True),
+        jnp.zeros((N, H), jnp.float32).at[i].add(
+            b.astype(jnp.float32), indices_are_sorted=True),
+        jnp.zeros((N, 1), jnp.float32).at[i].add(
+            jnp.ones((E, 1), jnp.float32), indices_are_sorted=True)))
+    f_packed = jax.jit(lambda a, b, i: jnp.zeros((N, H + 4), jnp.float32).at[i].add(
+        jnp.concatenate([a, b, jnp.ones((E, 1), a.dtype)],
+                        axis=-1).astype(jnp.float32),
+        indices_are_sorted=True))
+
     g_scatter = jax.jit(jax.grad(lambda d: f_scatter(d, ids).sum()))
     g_cumsum = jax.jit(jax.grad(lambda d: cumsum_diff(d, starts, ends).sum()))
     g_ell = jax.jit(jax.grad(lambda d: ell_sum(d, ell_idx, ell_msk).sum()))
@@ -137,6 +153,8 @@ def main():
     print(f"cumsum_diff_xla    {timed(f_cumsum, x, starts, ends):8.2f} ms")
     print(f"cumsum_diff_pallas {timed(f_cumsum_pl, x, starts, ends):8.2f} ms")
     print(f"ell_gather_sum     {timed(f_ell, x, ell_idx, ell_msk):8.2f} ms")
+    print(f"three_scatters     {timed(f_three, x3, x, ids):8.2f} ms")
+    print(f"packed_scatter     {timed(f_packed, x3, x, ids):8.2f} ms")
     print(f"vjp_scatter        {timed(g_scatter, x):8.2f} ms")
     print(f"vjp_cumsum         {timed(g_cumsum, x):8.2f} ms")
     print(f"vjp_ell            {timed(g_ell, x):8.2f} ms")

@@ -453,7 +453,9 @@ def main():
             on_hardware = caller_plat != "cpu"
             probed_plat = f"{caller_plat} (probe delegated to caller)"
         else:
-            probed_plat = probed_plat or "unverified (BENCH_PROBE=0, no attestation)"
+            # nothing verified the backend — record that, NOT the requested
+            # platform (BENCH_PLATFORM is a wish, not a measurement)
+            probed_plat = "unverified (BENCH_PROBE=0, no attestation)"
 
     # Pause provably-CPU-pinned competitors for the measurement window
     # (resumed in the finally below; a driver SIGTERM also resumes them via

@@ -63,8 +63,11 @@ class EGCLVel(nn.Module):
     seg_impl: str = "scatter"  # plain-layout aggregation lowering ('scatter'|'cumsum'|'ell')
     # one packed aggregation pass per layer (translations + edge features +
     # count ride a single segment sum — EdgeOps.agg_rows_pair) instead of
-    # two aggregations and a count. Math-identical for scatter/ell (f32
-    # accumulation either way); cumsum differs only in prefix rounding.
+    # two aggregations and a count. Same math; accumulation is ALWAYS f32 in
+    # the fused path, so under compute_dtype=bf16 it is slightly MORE
+    # precise than the legacy two-call path (whose bf16 edge_feat
+    # aggregation accumulated in bf16) — not bit-identical for bf16 models;
+    # fuse_agg=False restores the legacy numerics exactly.
     fuse_agg: bool = True
     # stream dtype of the packed aggregation ('bf16' halves the [E,3+H] read
     # bytes; accumulation stays f32). bf16 ROUNDS THE COORDINATE

@@ -88,7 +88,6 @@ Graph contract(const Graph& g, const std::vector<int64_t>& cmap, int64_t nc) {
   // timestamped scratch: pos[cv] = index in the adjacency row being built
   std::vector<int64_t> pos(nc, -1), stamp(nc, -1);
   for (int64_t cu = 0; cu < nc; ++cu) {
-    const int64_t row_begin = static_cast<int64_t>(c.indices.size());
     for (int64_t m = cstart[cu]; m < cstart[cu + 1]; ++m) {
       int64_t u = members[m];
       for (int64_t e = g.indptr[u]; e < g.indptr[u + 1]; ++e) {
@@ -105,7 +104,6 @@ Graph contract(const Graph& g, const std::vector<int64_t>& cmap, int64_t nc) {
       }
     }
     c.indptr[cu + 1] = static_cast<int64_t>(c.indices.size());
-    (void)row_begin;
   }
   return c;
 }

@@ -186,7 +186,10 @@ bench_and_check() {
   # probe's own jax.devices() report (tpu_probe.sh writes it), never a
   # literal: a jax that silently fell back to CPU must attest 'cpu'
   # (code-review r4).
-  BENCH_PROBE=0 BENCH_CALLER_PROBED="$(cat /tmp/tpu_probe.platform 2>/dev/null || echo tpu)" \
+  # No fallback literal: if the probe's platform record is missing the
+  # attestation stays EMPTY and bench routes the run to the CPU artifact —
+  # failing safe instead of stamping hardware evidence (code-review r4 #2).
+  BENCH_PROBE=0 BENCH_CALLER_PROBED="$(cat /tmp/tpu_probe.platform 2>/dev/null || true)" \
     python bench.py | tee /tmp/bench_last.json
   # Validate AND persist: extract the single measurement JSON line (stdout
   # may carry warnings) and, if it is a real measurement, write it as a

@@ -589,6 +589,13 @@ def main():
                 fails.append(f"{child_args}: timed out")
             except Exception as e:
                 fails.append(f"{child_args}: {e!r}")
+            # Persist INCREMENTALLY: a bench killed mid-race (driver budget,
+            # tunnel wedge hanging a later child) must not lose the legs
+            # that already finished — each completed child updates the
+            # artifact with a partial=True stamp the final write clears.
+            persist_race(records, fails + ["partial: race still running"],
+                         probe_ok, platform=probed_plat,
+                         on_hardware=on_hardware)
     finally:
         _resume()
     if ambiguous:

@@ -16,9 +16,12 @@ Layouts (docs/PERFORMANCE.md):
   blocked      — blocked-CSR layout, one-hot contraction ops (ops/blocked.py;
                  --impl einsum|pallas selects the lowering); hardware-measured
                  slower than plain, kept for explicit runs only
-Default is auto: measure plain-cumsum, plain-ell AND plain-scatter, each
-in a child process (so a compiler surprise on new hardware cannot take down
-the bench), and report the faster real measurement.
+Default is auto: race the production candidates — fused+reordered scatter
+(f32 and bf16 aggregation streams), the Pallas-prefix cumsum lowering (bf16
+and f32), and the unfused/unreordered anchor control — each in a child
+process (so a compiler surprise on new hardware cannot take down the
+bench), and report the fastest real measurement. ELL and both blocked
+generations are hardware-refuted (BASELINE.md 2026-08-02) and retired.
 
 Timing methodology (v2, round 2 — see BASELINE.md "Measurement integrity"):
 round 1 timed a donated jit with jax.block_until_ready, which RETURNS EARLY
@@ -230,7 +233,13 @@ def measure(edge_block: int, impl: str = "einsum", seg: str = "scatter",
                      hidden_nf=HIDDEN, virtual_channels=CHANNELS, n_layers=LAYERS,
                      compute_dtype="bf16", blocked_impl=impl, segment_impl=seg,
                      fuse_agg=fuse,
-                     agg_dtype=os.environ.get("BENCH_AGG_DTYPE") or None)
+                     agg_dtype=os.environ.get("BENCH_AGG_DTYPE") or None,
+                     # racing knob: without remat the backward re-reads ~10
+                     # GiB of saved [E,.] activations — at the measured
+                     # effective HBM bandwidth that can exceed the recompute
+                     # cost remat pays instead (profile 2026-08-02: bwd =
+                     # 2.8x fwd). Default off = the historical bench config.
+                     remat=bool(_env_int("BENCH_REMAT", 0)))
     params = model.init(jax.random.PRNGKey(0), batch)
     tx = make_optimizer(5e-4, weight_decay=1e-12, clip_norm=0.3)
     state = TrainState.create(params, tx)
@@ -272,6 +281,8 @@ def measure(edge_block: int, impl: str = "einsum", seg: str = "scatter",
         layout += "+noreorder"
     if os.environ.get("BENCH_AGG_DTYPE"):
         layout += f"+agg{os.environ['BENCH_AGG_DTYPE']}"
+    if _env_int("BENCH_REMAT", 0):
+        layout += "+remat"
     official = N_NODES == 113_140  # vs_baseline is meaningless off-workload
     return {
         "metric": "largefluid_train_nodes_per_sec_per_chip",
@@ -530,24 +541,24 @@ def main():
     best, records, fails = None, [], []
     first = True
     try:
-        # Race order: the two scatter-free candidates first, then the legacy
-        # control (unfused, unreordered scatter — the round-2 anchor
-        # configuration, tying this session's numbers to the committed
-        # anchor), then the optimized scatter path. Each leg's extra env
-        # rides the 4th tuple element.
-        # Last leg: the gen-2 blocked-kernel configuration — 512-node blocks
-        # x 2048-edge tiles (8x the refuted kernel's work per grid step,
-        # ~4x fewer grid steps) with bf16 streams (single-pass MXU instead
-        # of f32 precision=HIGHEST 6-pass). Speculative: runs only if the
-        # wall budget survives the production candidates.
+        # Race order, rewritten after the 2026-08-02 hardware race
+        # (BASELINE.md round-4 hardware session): best-known leg FIRST so an
+        # early budget death still records the headline; then the two bf16
+        # aggregation-stream candidates (the largest unmeasured lever —
+        # halves the dominant [E,64] HBM streams; the prefix kernel already
+        # beat scatter at bf16 in microbench_segsum: 14.5 vs 21.5 ms); then
+        # f32 cumsum (completes the seg x dtype matrix); then the legacy
+        # control (unfused, unreordered scatter — ties the session to the
+        # committed anchor). ELL (0.633x) and both blocked generations
+        # (0.784x, 0.446x) are hardware-refuted and retired from the race.
         for child_args, child_env in (
-                (["--layout", "plain", "--seg", "cumsum"], None),
-                (["--layout", "plain", "--seg", "ell"], None),
-                (["--layout", "plain", "--fuse", "0"], {"BENCH_REORDER": "0"}),
                 (["--layout", "plain"], None),
-                (["--layout", "blocked", "--impl", "pallas"],
-                 {"BENCH_EDGE_BLOCK": "512", "BENCH_EDGE_TILE": "2048",
-                  "BENCH_AGG_DTYPE": "bf16"})):
+                (["--layout", "plain"], {"BENCH_AGG_DTYPE": "bf16"}),
+                (["--layout", "plain"], {"BENCH_REMAT": "1"}),
+                (["--layout", "plain", "--seg", "cumsum"],
+                 {"BENCH_AGG_DTYPE": "bf16"}),
+                (["--layout", "plain", "--seg", "cumsum"], None),
+                (["--layout", "plain", "--fuse", "0"], {"BENCH_REORDER": "0"})):
             # Skip rather than admit a child that could only finish by being
             # timeout-killed: a timeout SIGKILLs a LIVE client
             # mid-measurement, which strands the remote claim (the

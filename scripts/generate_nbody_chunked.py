@@ -44,8 +44,23 @@ for _i, _a in enumerate(sys.argv):
         _plat = sys.argv[_i + 1]
     elif _a.startswith("--platform="):
         _plat = _a.split("=", 1)[1]
-if _plat != "auto":
+# "tpu" must NOT pin jax_platforms="tpu": on axon-tunnel hosts the chip is
+# served by the experimental "axon" platform, and requesting "tpu" tries a
+# local TPU init that dies with "No jellyfish device found" (hardware run
+# 2026-08-02). Default platform resolution prefers any available accelerator,
+# which is the intent of --platform tpu on every host we run on.
+if _plat not in ("auto", "tpu"):
     jax.config.update("jax_platforms", _plat)
+if _plat in ("auto", "tpu"):
+    # default resolution can silently land on CPU (e.g. dead tunnel) — say
+    # what we actually got, and fail the explicit-tpu request loudly rather
+    # than run a ~3-minute accelerator job for hours on one core
+    _got = jax.devices()[0].platform
+    print(f"generate_nbody_chunked: backend={_got} "
+          f"({jax.devices()[0].device_kind})", flush=True)
+    if _plat == "tpu" and _got == "cpu":
+        sys.exit("--platform tpu requested but only CPU is available "
+                 "(tunnel down?); use --platform cpu to run on CPU anyway")
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 

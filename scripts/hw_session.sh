@@ -291,6 +291,13 @@ run largefluid_epoch largefluid_epoch_and_check
 run remat_xla_temp python scripts/measure_remat_memory.py --nodes 113140 \
   --xla-temp --json docs/artifacts/remat_memory_tpu.json
 
+# 3d. machine roofline probe (minutes): copy/matmul/gather/scatter ceilings
+#     + analytic step floor — the "HBM-bound, no headroom" evidence VERDICT
+#     r3 #1 names as an acceptable done-criterion, and the compass for any
+#     further fusion work.
+run microbench_roofline python scripts/microbench_roofline.py \
+  --json docs/artifacts/roofline_tpu.json
+
 # 4. convergence in STAGES: at ~15 s/epoch on-chip the full 2500-epoch
 #    protocol is ~10 h — longer than any observed tunnel window. Each stage
 #    resumes from the previous stage's last_model.ckpt and captures

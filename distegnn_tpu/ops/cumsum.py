@@ -75,7 +75,9 @@ def _suffix_kernel(x_ref, out_ref, carry_ref):
     T = x.shape[0]
     k = 1
     while k < T:
-        shifted = pltpu.roll(x, -k, axis=0)
+        # upward roll by k == pltpu.roll by T-k (pltpu.roll rejects negative
+        # shifts); the rows < T-k mask zeroes the wrapped-around rows either way
+        shifted = pltpu.roll(x, T - k, axis=0)
         x = x + jnp.where(rows < T - k, shifted, 0.0)
         k *= 2
     c = x + carry_ref[...]

@@ -208,6 +208,14 @@ with open(tmp, 'w') as f:
     f.write(line)
 os.replace(tmp, 'docs/artifacts/bench_r3_measured.json')
 EOF
+  # Immutable dated archives (ADVICE r4): the rolling headline/race files
+  # are overwritten by every session — BASELINE.md must cite these instead.
+  local stamp
+  stamp=$(date -u +%Y%m%dT%H%M%S)
+  cp docs/artifacts/bench_r3_measured.json \
+     "docs/artifacts/bench_headline_$stamp.json" 2>/dev/null || true
+  cp docs/artifacts/bench_race_last.json \
+     "docs/artifacts/bench_race_$stamp.json" 2>/dev/null || true
 }
 
 # The chunked generator deletes chunks/ after the final merge, so re-invoking
@@ -254,15 +262,7 @@ else
 fi
 run nbody_gen_tpu nbody_gen_and_check
 
-# 3. detail (cheap, minutes): isolate the segment-sum lowerings + step
-#    breakdowns — the per-primitive evidence behind the bench race, wanted
-#    in the FIRST window (VERDICT r2 next-round #1).
-run microbench_segsum python scripts/microbench_segsum.py
-run microbench_segsum_bf16 python scripts/microbench_segsum.py --bf16
-run profile_cumsum python scripts/profile_step.py --bf16 --seg cumsum
-run profile_plain python scripts/profile_step.py --bf16
-
-# 3b. one real LargeFluid epoch on chip, end to end (VERDICT r3 #3): the
+# 3. one real LargeFluid epoch on chip, end to end (VERDICT r4 #4): the
 #     flagship largefluid_distegnn.yaml through main.py — 113,140 nodes,
 #     metis partition shards, grad accum 4, MMD, remat, distribute mode.
 #     Data: the synthetic Fluid113K-format generator at full particle count
@@ -285,18 +285,25 @@ largefluid_epoch_and_check() {
 }
 run largefluid_epoch largefluid_epoch_and_check
 
-# 3c. remat memory on the REAL backend: XLA:CPU provably discards
-#     rematerialization in buffer assignment (docs/PERFORMANCE.md), so the
-#     compiled-temp comparison only means something here.
-run remat_xla_temp python scripts/measure_remat_memory.py --nodes 113140 \
-  --xla-temp --json docs/artifacts/remat_memory_tpu.json
-
-# 3d. machine roofline probe (minutes): copy/matmul/gather/scatter ceilings
-#     + analytic step floor — the "HBM-bound, no headroom" evidence VERDICT
-#     r3 #1 names as an acceptable done-criterion, and the compass for any
-#     further fusion work.
+# 3b. machine roofline probe (minutes): copy/matmul/gather/scatter ceilings
+#     + analytic step floor — pairs with the new hbm_gbps field in the bench
+#     line (VERDICT r4 #7) to place every lowering on the memory roofline.
 run microbench_roofline python scripts/microbench_roofline.py \
   --json docs/artifacts/roofline_tpu.json
+
+# 3c. detail (cheap, minutes): isolate the segment-sum lowerings + step
+#     breakdowns — the per-primitive evidence behind the bench race.
+run microbench_segsum python scripts/microbench_segsum.py
+run microbench_segsum_bf16 python scripts/microbench_segsum.py --bf16
+run profile_cumsum python scripts/profile_step.py --bf16 --seg cumsum
+run profile_plain python scripts/profile_step.py --bf16
+
+# 3d. remat memory on the REAL backend: XLA:CPU provably discards
+#     rematerialization in buffer assignment (docs/PERFORMANCE.md), so the
+#     compiled-temp comparison only means something here. Session-B measured
+#     remat as a 1.65x STEP-TIME win too (BASELINE.md round-4 session B).
+run remat_xla_temp python scripts/measure_remat_memory.py --nodes 113140 \
+  --xla-temp --json docs/artifacts/remat_memory_tpu.json
 
 # 4. convergence in STAGES: at ~15 s/epoch on-chip the full 2500-epoch
 #    protocol is ~10 h — longer than any observed tunnel window. Each stage

@@ -1,0 +1,80 @@
+"""The bench headline must be un-losable (VERDICT r4 #1): round 4 had a live
+tunnel, finished 4 race legs, and still delivered `parsed: null` because the
+only stdout print sat after the whole race and the driver's timeout hit
+first. The contract now: after EVERY finished leg bench.py prints the
+best-so-far headline JSON line (flushed), so killing the process at ANY
+point after >=1 finished leg leaves a parseable headline in the captured
+tail. This test runs a tiny CPU race, waits for the first headline line,
+SIGKILLs the bench mid-race, and parses what was captured."""
+
+from __future__ import annotations
+
+import json
+import os
+import shutil
+import signal
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.mark.slow
+def test_sigkill_mid_race_still_yields_headline(tmp_path):
+    # bench.py resolves repo_dir (and its race-artifact paths) from its own
+    # file location — run a COPY from tmp_path so the test can never clobber
+    # the committed hardware/CPU race artifacts under docs/artifacts/.
+    bench_copy = tmp_path / "bench.py"
+    shutil.copy(os.path.join(REPO, "bench.py"), bench_copy)
+    env = dict(
+        os.environ,
+        PYTHONPATH=REPO + os.pathsep + os.environ.get("PYTHONPATH", ""),
+        BENCH_PLATFORM="cpu",   # no probe, no competitor pausing
+        BENCH_PAUSE="0",
+        BENCH_NODES="1500",     # tiny workload: first leg finishes in ~tens of s
+        JAX_PLATFORMS="cpu",
+    )
+    proc = subprocess.Popen(
+        [sys.executable, str(bench_copy)],
+        stdout=subprocess.PIPE, stderr=subprocess.DEVNULL,
+        text=True, cwd=str(tmp_path), env=env,
+    )
+    lines = []
+    try:
+        # read until the first best-so-far headline appears, then kill the
+        # race mid-flight — exactly the driver-timeout scenario
+        import threading
+
+        got_headline = threading.Event()
+
+        def reader():
+            for line in proc.stdout:
+                lines.append(line)
+                try:
+                    rec = json.loads(line)
+                except json.JSONDecodeError:
+                    continue
+                if isinstance(rec, dict) and rec.get("metric"):
+                    got_headline.set()
+                    return
+
+        t = threading.Thread(target=reader, daemon=True)
+        t.start()
+        assert got_headline.wait(timeout=600), (
+            "no headline JSON line within 600s of race start; captured: "
+            f"{lines!r}")
+        proc.send_signal(signal.SIGKILL)
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+        proc.wait(timeout=30)
+
+    # the captured tail must contain a parseable headline with a real value
+    parsed = [json.loads(l) for l in lines
+              if l.lstrip().startswith("{")]
+    headlines = [p for p in parsed if isinstance(p, dict) and p.get("metric")]
+    assert headlines, f"no parseable headline in captured tail: {lines!r}"
+    assert headlines[-1]["value"] > 0
+    assert "nodes/sec" in headlines[-1]["unit"]

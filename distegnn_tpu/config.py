@@ -142,6 +142,36 @@ _DEFAULTS: dict = {
         # it for single-process cutoff_edges runs whose dataset fits in HBM.
         "scan_epochs": "auto",
     },
+    # serving layer (distegnn_tpu/serve, docs/SERVING.md) — the bucket
+    # ladder, micro-batcher, and compile cache of the inference engine
+    "serve": {
+        # geometric (N, E) shape ladder: rung k = floor * growth^k rounded
+        # to the multiples; requests above the caps are rejected (admission
+        # control), not compiled
+        "node_floor": 64,
+        "edge_floor": 256,
+        "growth": 2.0,
+        "node_multiple": 8,
+        "edge_multiple": 128,
+        "max_nodes": 65536,
+        "max_edges": 1 << 20,
+        # micro-batcher: coalesce same-bucket requests up to max_batch or
+        # until the oldest has waited batch_deadline_ms; every compiled
+        # program runs at EXACTLY max_batch (one executable per rung)
+        "max_batch": 8,
+        "batch_deadline_ms": 5.0,
+        # bounded ingress (submits beyond it fail fast = backpressure) and
+        # per-request queued-time deadline
+        "queue_capacity": 256,
+        "request_timeout_ms": 1000.0,
+        # compile-cache LRU size (live executables) and input-buffer
+        # donation: 'auto' = donate on TPU only (CPU ignores donation)
+        "cache_size": 32,
+        "donate": "auto",
+        # optional K-step rollout serving (rollout.make_rollout_fn kwargs);
+        # null disables the rollout endpoint
+        "rollout": None,
+    },
     "log": {
         "log_dir": "./logs",
         "test_interval": 2,
@@ -261,6 +291,20 @@ def validate_config(cfg: ConfigDict) -> None:
         raise ValueError("train.accumulation_steps must be >= 1")
     if cfg.model.virtual_channels < 1:
         raise ValueError("model.virtual_channels must be >= 1")
+    s = cfg.get("serve")
+    if s is None:
+        return  # hand-built config without the serving section
+    if float(s.growth) <= 1.0:
+        raise ValueError("serve.growth must be > 1")
+    if int(s.max_batch) < 1 or int(s.cache_size) < 1:
+        raise ValueError("serve.max_batch and serve.cache_size must be >= 1")
+    if int(s.queue_capacity) < 1:
+        raise ValueError("serve.queue_capacity must be >= 1")
+    if float(s.batch_deadline_ms) < 0 or float(s.request_timeout_ms) <= 0:
+        raise ValueError("serve.batch_deadline_ms must be >= 0 and "
+                         "serve.request_timeout_ms > 0")
+    if s.donate not in (True, False, "auto"):
+        raise ValueError("serve.donate must be true, false, or 'auto'")
 
 
 def derive_runtime_fields(cfg: ConfigDict, world_size: Optional[int] = None) -> ConfigDict:

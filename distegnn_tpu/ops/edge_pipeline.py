@@ -107,6 +107,17 @@ def _split2(x):
 
 # ---------------------------------------------------------------- layout
 
+def _validate_block(block: int) -> None:
+    """The one-hot aggregation is chunked at OH_CHUNK: a block smaller than
+    one chunk would run ZERO chunks (all-zero aggregates) and a non-multiple
+    would silently drop the tail edges of every tile — fail loudly instead."""
+    if block < OH_CHUNK or block % OH_CHUNK:
+        raise ValueError(
+            f"edge_pipeline requires block to be a multiple of OH_CHUNK="
+            f"{OH_CHUNK} and >= {OH_CHUNK} (got block={block}): the chunked "
+            f"one-hot aggregation would drop edges otherwise")
+
+
 def build_edge_blocks(row, col, edge_attr, edge_mask, *, block, n_nodes):
     """Blocked-layout [E] edge arrays -> the kernel's flat HBM layout.
 
@@ -119,6 +130,7 @@ def build_edge_blocks(row, col, edge_attr, edge_mask, *, block, n_nodes):
     Edges with cols outside the 3-block window are masked out (they belong
     to the remote path, `split_remote_edges`).
     """
+    _validate_block(block)
     nb = n_nodes // block
     E = row.shape[0]
     epb = E // nb
@@ -146,18 +158,28 @@ def build_edge_blocks(row, col, edge_attr, edge_mask, *, block, n_nodes):
 
 
 def split_remote_edges(edge_index: np.ndarray, edge_attr: np.ndarray,
-                       *, block: int, n_pad: Optional[int] = None
+                       *, block: int, n_nodes: int,
+                       n_pad: Optional[int] = None
                        ) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
     """numpy (loader-side): extract the out-of-window edges into a compact
     row-sorted plain edge list for the XLA remote path.
+
+    ``n_nodes`` is the padded node count of the blocked layout; ``nb`` MUST
+    be derived from it exactly as `build_edge_blocks` does (n_nodes // block),
+    NOT inferred from the edges — with trailing node blocks that receive no
+    edges the two would disagree on the window clamp near the top and an edge
+    could be classified in-window by one function and remote by the other
+    (double-counted or dropped once both paths are aggregated).
 
     Returns (remote_edge_index [2, Er], remote_edge_attr [Er, D],
     remote_mask [Er]) padded to ``n_pad`` (default: next multiple of 128).
     Padding points at node 0 with mask 0 — the pad_graphs convention.
     """
+    if n_nodes % block:
+        raise ValueError(f"n_nodes={n_nodes} not a multiple of block={block}")
     row, col = edge_index[0], edge_index[1]
     br, bc = row // block, col // block
-    nb = int(br.max()) + 1 if row.size else 1
+    nb = n_nodes // block
     s = np.clip(br - 1, 0, max(nb - 3, 0))
     remote = (bc < s) | (bc > s + 2)
     r_idx = np.where(remote)[0]
@@ -393,11 +415,27 @@ def _pack_inputs(x, hr, hc, weights, n_nodes, dtype):
     return xp, pk, wlist
 
 
+def _check_grid(n_nodes: int, block: int) -> int:
+    """The win(k) BlockSpec index maps address node blocks s..s+2; with
+    nb < 3 they would index past the array and rely on unspecified Mosaic
+    out-of-bounds block clamping — reject small graphs loudly (route them
+    through the plain EdgeOps path instead)."""
+    _validate_block(block)
+    nb = n_nodes // block
+    if nb < 3:
+        raise ValueError(
+            f"fused_edge_layer needs at least 3 node blocks (n_nodes="
+            f"{n_nodes}, block={block} -> nb={nb}): the 3-block VMEM window "
+            f"would index out of bounds; use the plain EdgeOps path for "
+            f"graphs smaller than {3 * block} padded nodes")
+    return nb
+
+
 def _fused_fwd_impl(x, hr, hc, row_t, col_l, kblk, scal, weights,
                     *, block, dtype_name):
     T = block
     n_nodes, H = hr.shape[0], hr.shape[1]
-    nb = n_nodes // T
+    nb = _check_grid(n_nodes, T)
     nt = row_t.shape[0] // nb
     dtype = jnp.bfloat16 if dtype_name == "bf16" else jnp.float32
     xp, pk, wlist = _pack_inputs(x, hr, hc, weights, n_nodes, dtype)
@@ -421,7 +459,7 @@ def _fused_bwd_impl(x, hr, hc, row_t, col_l, kblk, scal, weights,
                     g_trans, g_ef, *, block, dtype_name):
     T = block
     n_nodes, H = hr.shape[0], hr.shape[1]
-    nb = n_nodes // T
+    nb = _check_grid(n_nodes, T)
     nt = row_t.shape[0] // nb
     dtype = jnp.bfloat16 if dtype_name == "bf16" else jnp.float32
     xp, pk, wlist = _pack_inputs(x, hr, hc, weights, n_nodes, dtype)

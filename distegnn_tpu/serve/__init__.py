@@ -1,0 +1,40 @@
+"""distegnn_tpu.serve — bucketed-batching inference (docs/SERVING.md).
+
+Request path: RequestQueue.submit(graph) -> bucket ladder -> micro-batcher
+-> InferenceEngine per-bucket compile cache -> ServeFuture result. All
+components share one ServeMetrics snapshot.
+"""
+
+from distegnn_tpu.serve.buckets import (Bucket, BucketLadder,
+                                        BucketOverflowError, synthetic_graph)
+from distegnn_tpu.serve.engine import InferenceEngine, RolloutOverflowError
+from distegnn_tpu.serve.metrics import ServeMetrics
+from distegnn_tpu.serve.queue import (QueueFullError, RequestQueue,
+                                      RequestTimeoutError, ServeFuture)
+
+__all__ = [
+    "Bucket", "BucketLadder", "BucketOverflowError", "synthetic_graph",
+    "InferenceEngine", "RolloutOverflowError", "ServeMetrics",
+    "QueueFullError", "RequestQueue", "RequestTimeoutError", "ServeFuture",
+    "engine_from_config",
+]
+
+
+def engine_from_config(cfg, model, params, metrics=None):
+    """Build (InferenceEngine, RequestQueue) from a config's ``serve:``
+    section (distegnn_tpu.config defaults; queue NOT started)."""
+    s = cfg.serve
+    ladder = BucketLadder(
+        node_floor=s.node_floor, edge_floor=s.edge_floor, growth=s.growth,
+        node_multiple=s.node_multiple, edge_multiple=s.edge_multiple,
+        max_nodes=s.max_nodes, max_edges=s.max_edges)
+    metrics = metrics or ServeMetrics()
+    engine = InferenceEngine(
+        model, params, ladder=ladder, max_batch=s.max_batch,
+        cache_size=s.cache_size, donate=s.donate, metrics=metrics,
+        rollout_opts=(s.rollout.to_dict() if s.get("rollout") else None))
+    q = RequestQueue(
+        engine, batch_deadline_ms=s.batch_deadline_ms,
+        queue_capacity=s.queue_capacity,
+        request_timeout_ms=s.request_timeout_ms, metrics=metrics)
+    return engine, q

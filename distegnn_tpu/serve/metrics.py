@@ -1,0 +1,129 @@
+"""Serving metrics — thread-safe counters + a JSON-able snapshot.
+
+One `ServeMetrics` instance is shared by the engine (compile cache, execute
+latencies) and the batcher (queue depth, fill ratio, rejections). Everything
+is a plain counter or a bounded latency reservoir guarded by one lock — the
+serving hot path adds microseconds, never blocks on I/O.
+
+Snapshot schema (docs/SERVING.md "Metrics"): every field is a number, so the
+snapshot is directly a Prometheus-style scrape body or one BENCH JSON line.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+from typing import Dict, List, Optional
+
+
+def _percentile(sorted_vals: List[float], q: float) -> float:
+    """Nearest-rank percentile on an ascending list (0 <= q <= 100)."""
+    if not sorted_vals:
+        return 0.0
+    idx = min(len(sorted_vals) - 1, max(0, int(round(q / 100.0 * (len(sorted_vals) - 1)))))
+    return sorted_vals[idx]
+
+
+class ServeMetrics:
+    """Counters for the serving path. All methods are thread-safe.
+
+    Latencies are recorded in milliseconds into a bounded reservoir (the most
+    recent ``reservoir`` samples) — p50/p99 are computed at snapshot time, so
+    the record path is O(1).
+    """
+
+    def __init__(self, reservoir: int = 8192):
+        self._lock = threading.Lock()
+        self._reservoir = int(reservoir)
+        self._t0 = time.perf_counter()
+        self._lat_ms: List[float] = []
+        self._queue_ms: List[float] = []
+        self.requests_submitted = 0
+        self.requests_completed = 0
+        self.requests_failed = 0      # engine/model errors surfaced on futures
+        self.requests_timeout = 0     # deadline passed while queued
+        self.requests_rejected = 0    # bounded-queue backpressure (submit fails)
+        self.batches_executed = 0
+        self.batch_slots_total = 0    # sum of padded batch capacity over batches
+        self.batch_slots_filled = 0   # sum of real requests over batches
+        self.cache_hits = 0
+        self.cache_misses = 0
+        self.cache_evictions = 0
+        self.queue_depth = 0          # gauge, set by the batcher
+
+    # ---- recorders -------------------------------------------------------
+    def submitted(self, n: int = 1) -> None:
+        with self._lock:
+            self.requests_submitted += n
+
+    def rejected(self, n: int = 1) -> None:
+        with self._lock:
+            self.requests_rejected += n
+
+    def timed_out(self, n: int = 1) -> None:
+        with self._lock:
+            self.requests_timeout += n
+
+    def failed(self, n: int = 1) -> None:
+        with self._lock:
+            self.requests_failed += n
+
+    def batch_done(self, filled: int, capacity: int,
+                   latencies_ms: List[float],
+                   queue_ms_each: Optional[List[float]] = None) -> None:
+        """One executed micro-batch: ``filled`` real requests padded to
+        ``capacity`` slots, with one end-to-end latency per request."""
+        with self._lock:
+            self.batches_executed += 1
+            self.batch_slots_total += capacity
+            self.batch_slots_filled += filled
+            self.requests_completed += filled
+            self._lat_ms.extend(latencies_ms)
+            if queue_ms_each:
+                self._queue_ms.extend(queue_ms_each)
+            del self._lat_ms[:-self._reservoir]
+            del self._queue_ms[:-self._reservoir]
+
+    def cache_event(self, hit: bool, evicted: int = 0) -> None:
+        with self._lock:
+            if hit:
+                self.cache_hits += 1
+            else:
+                self.cache_misses += 1
+            self.cache_evictions += evicted
+
+    def set_queue_depth(self, depth: int) -> None:
+        with self._lock:
+            self.queue_depth = depth
+
+    # ---- export ----------------------------------------------------------
+    def snapshot(self) -> Dict[str, float]:
+        with self._lock:
+            lat = sorted(self._lat_ms)
+            qms = sorted(self._queue_ms)
+            elapsed = max(time.perf_counter() - self._t0, 1e-9)
+            fill = (self.batch_slots_filled / self.batch_slots_total
+                    if self.batch_slots_total else 0.0)
+            return {
+                "uptime_s": round(elapsed, 3),
+                "requests_submitted": self.requests_submitted,
+                "requests_completed": self.requests_completed,
+                "requests_failed": self.requests_failed,
+                "requests_timeout": self.requests_timeout,
+                "requests_rejected": self.requests_rejected,
+                "requests_per_sec": round(self.requests_completed / elapsed, 3),
+                "batches_executed": self.batches_executed,
+                "batch_fill_ratio": round(fill, 4),
+                "latency_p50_ms": round(_percentile(lat, 50), 3),
+                "latency_p99_ms": round(_percentile(lat, 99), 3),
+                "queue_wait_p50_ms": round(_percentile(qms, 50), 3),
+                "queue_wait_p99_ms": round(_percentile(qms, 99), 3),
+                "cache_hits": self.cache_hits,
+                "cache_misses": self.cache_misses,
+                "cache_evictions": self.cache_evictions,
+                "queue_depth": self.queue_depth,
+            }
+
+    def to_json(self) -> str:
+        return json.dumps(self.snapshot(), sort_keys=True)

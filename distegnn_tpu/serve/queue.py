@@ -1,0 +1,259 @@
+"""Request queue + micro-batcher — coalesce same-bucket requests.
+
+One dispatcher thread owns the serving loop: it drains a BOUNDED ingress
+queue into per-bucket pending lists and flushes a bucket as a micro-batch
+when it reaches the batch cap OR its oldest request has waited the batching
+deadline — the classic latency/throughput knob (deadline 0 = no batching,
+larger = fuller batches, +deadline worst-case added latency).
+
+Failure surfaces (never silent, matching the overflow-flag contract in
+rollout.py):
+  - ingress full            -> QueueFullError raised AT SUBMIT (backpressure)
+  - graph exceeds ladder    -> BucketOverflowError raised at submit
+  - deadline passed queued  -> RequestTimeoutError set on the future
+  - engine/model exception  -> set on every future of the batch
+
+Device execution runs inline in the dispatcher thread: the accelerator is a
+serial resource, so a thread pool would only add queueing ambiguity. The
+GIL is released inside XLA execution, so submitters keep running.
+"""
+
+from __future__ import annotations
+
+import queue as _pyqueue
+import threading
+import time
+from typing import Dict, List, Optional
+
+from distegnn_tpu.serve.buckets import Bucket, BucketLadder, BucketOverflowError
+from distegnn_tpu.serve.engine import InferenceEngine
+from distegnn_tpu.serve.metrics import ServeMetrics
+
+
+class QueueFullError(RuntimeError):
+    """Bounded ingress queue is full — shed load at the edge."""
+
+
+class RequestTimeoutError(RuntimeError):
+    """The request's deadline passed before a batch picked it up."""
+
+
+class ServeFuture:
+    """Minimal one-shot future (no asyncio dependency in the serving core)."""
+
+    def __init__(self):
+        self._event = threading.Event()
+        self._result = None
+        self._exc: Optional[BaseException] = None
+
+    def set_result(self, value) -> None:
+        self._result = value
+        self._event.set()
+
+    def set_exception(self, exc: BaseException) -> None:
+        self._exc = exc
+        self._event.set()
+
+    def done(self) -> bool:
+        return self._event.is_set()
+
+    def result(self, timeout: Optional[float] = None):
+        if not self._event.wait(timeout):
+            raise TimeoutError("serve future not ready")
+        if self._exc is not None:
+            raise self._exc
+        return self._result
+
+
+class _Request:
+    __slots__ = ("graph", "bucket", "future", "t_submit", "deadline")
+
+    def __init__(self, graph: dict, bucket: Bucket, deadline: float):
+        self.graph = graph
+        self.bucket = bucket
+        self.future = ServeFuture()
+        self.t_submit = time.perf_counter()
+        self.deadline = deadline
+
+
+_STOP = object()
+
+
+class RequestQueue:
+    """Bounded ingress + per-bucket micro-batcher over an InferenceEngine.
+
+    Args:
+      engine: the compiled-shape executor (its ladder buckets requests).
+      batch_deadline_ms: max time the OLDEST pending request of a bucket
+        waits for co-batchable traffic before the bucket flushes.
+      queue_capacity: ingress bound; submits beyond it raise QueueFullError.
+      request_timeout_ms: per-request deadline (queued time only — an
+        admitted request that starts executing always completes).
+    """
+
+    def __init__(self, engine: InferenceEngine, *,
+                 batch_deadline_ms: float = 5.0, queue_capacity: int = 256,
+                 request_timeout_ms: float = 1000.0,
+                 metrics: Optional[ServeMetrics] = None):
+        self.engine = engine
+        self.metrics = metrics or engine.metrics
+        self.batch_deadline = batch_deadline_ms / 1e3
+        self.request_timeout = request_timeout_ms / 1e3
+        self._ingress: "_pyqueue.Queue" = _pyqueue.Queue(maxsize=queue_capacity)
+        self._pending: Dict[Bucket, List[_Request]] = {}
+        self._thread: Optional[threading.Thread] = None
+        self._started = False
+
+    @property
+    def ladder(self) -> BucketLadder:
+        return self.engine.ladder
+
+    # ---- lifecycle -------------------------------------------------------
+    def start(self) -> "RequestQueue":
+        if self._started:
+            return self
+        self._started = True
+        self._thread = threading.Thread(target=self._loop,
+                                        name="serve-dispatch", daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self, drain: bool = True) -> None:
+        """Stop the dispatcher. ``drain=True`` flushes everything already
+        admitted; False fails pending futures with RequestTimeoutError."""
+        if not self._started:
+            return
+        self._ingress.put((_STOP, drain))
+        self._thread.join(timeout=30.0)
+        self._started = False
+        # a submit racing the final drain check could leave a request in the
+        # ingress after the dispatcher exited — fail it, never strand it
+        self._fail_all(RequestTimeoutError("server stopped"))
+
+    def __enter__(self) -> "RequestQueue":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.stop()
+
+    # ---- submission ------------------------------------------------------
+    def submit(self, graph: dict) -> ServeFuture:
+        """Admit one pad_graphs-style graph dict; returns a ServeFuture
+        resolving to the predicted positions [n, 3] (numpy)."""
+        if not self._started:
+            raise RuntimeError("RequestQueue not started (use start() or a "
+                               "with-block)")
+        bucket = self.ladder.bucket_of_graph(graph)  # BucketOverflowError here
+        req = _Request(graph, bucket,
+                       deadline=time.perf_counter() + self.request_timeout)
+        try:
+            self._ingress.put_nowait(req)
+        except _pyqueue.Full:
+            self.metrics.rejected()
+            raise QueueFullError(
+                f"ingress queue full ({self._ingress.maxsize}); retry with "
+                f"backoff or raise serve.queue_capacity") from None
+        self.metrics.submitted()
+        return req.future
+
+    def depth(self) -> int:
+        return self._ingress.qsize() + sum(len(v) for v in self._pending.values())
+
+    # ---- dispatcher ------------------------------------------------------
+    def _next_flush_deadline(self) -> Optional[float]:
+        ts = [rs[0].t_submit + self.batch_deadline
+              for rs in self._pending.values() if rs]
+        return min(ts) if ts else None
+
+    def _absorb(self, item) -> bool:
+        """Move one ingress item into pending; returns True on _STOP."""
+        if isinstance(item, tuple) and item[0] is _STOP:
+            if not item[1]:  # drain=False: fail everything outstanding
+                self._fail_all(RequestTimeoutError("server stopped"))
+            return True
+        self._pending.setdefault(item.bucket, []).append(item)
+        return False
+
+    def _loop(self) -> None:
+        draining = False
+        while True:
+            now = time.perf_counter()
+            flush_at = self._next_flush_deadline()
+            timeout = None if flush_at is None else max(flush_at - now, 0.0)
+            if not draining:
+                try:
+                    item = self._ingress.get(timeout=timeout)
+                except _pyqueue.Empty:
+                    item = None
+                # absorb everything already arrived in one pass (no sleep);
+                # a _STOP flips to draining but this round still flushes
+                while item is not None:
+                    draining = self._absorb(item) or draining
+                    try:
+                        item = self._ingress.get_nowait()
+                    except _pyqueue.Empty:
+                        item = None
+            else:
+                while True:  # drain mode: empty the ingress, then flush all
+                    try:
+                        self._absorb(self._ingress.get_nowait())
+                    except _pyqueue.Empty:
+                        break
+            self.metrics.set_queue_depth(self.depth())
+
+            now = time.perf_counter()
+            for bucket in list(self._pending):
+                reqs = self._pending[bucket]
+                self._expire(bucket, reqs, now)
+                while len(reqs) >= self.engine.max_batch:
+                    self._execute(bucket, reqs[: self.engine.max_batch])
+                    del reqs[: self.engine.max_batch]
+                if reqs and (draining or
+                             now - reqs[0].t_submit >= self.batch_deadline):
+                    self._execute(bucket, reqs)
+                    reqs.clear()
+                if not reqs:
+                    del self._pending[bucket]
+            self.metrics.set_queue_depth(self.depth())
+            if draining and not self._pending and self._ingress.empty():
+                return
+
+    def _expire(self, bucket: Bucket, reqs: List[_Request], now: float) -> None:
+        alive = [r for r in reqs if r.deadline > now]
+        for r in reqs:
+            if r.deadline <= now:
+                self.metrics.timed_out()
+                r.future.set_exception(RequestTimeoutError(
+                    f"request waited > {self.request_timeout * 1e3:.0f} ms "
+                    f"in bucket {bucket}"))
+        reqs[:] = alive
+
+    def _execute(self, bucket: Bucket, reqs: List[_Request]) -> None:
+        t_start = time.perf_counter()
+        try:
+            outs = self.engine.predict_batch([r.graph for r in reqs],
+                                             bucket=bucket)
+        except Exception as exc:  # surface on every future, keep serving
+            self.metrics.failed(len(reqs))
+            for r in reqs:
+                r.future.set_exception(exc)
+            return
+        now = time.perf_counter()
+        lats = [(now - r.t_submit) * 1e3 for r in reqs]
+        qms = [(t_start - r.t_submit) * 1e3 for r in reqs]
+        self.metrics.batch_done(len(reqs), self.engine.max_batch, lats, qms)
+        for r, out in zip(reqs, outs):
+            r.future.set_result(out)
+
+    def _fail_all(self, exc: BaseException) -> None:
+        for reqs in self._pending.values():
+            for r in reqs:
+                r.future.set_exception(exc)
+        self._pending.clear()
+        while True:
+            try:
+                item = self._ingress.get_nowait()
+            except _pyqueue.Empty:
+                return
+            if not (isinstance(item, tuple) and item[0] is _STOP):
+                item.future.set_exception(exc)

@@ -1,0 +1,111 @@
+"""Serving benchmark: open-loop synthetic load through the serve stack.
+
+Drives RequestQueue -> InferenceEngine with a fixed-rate arrival process
+(OPEN loop: arrival k is scheduled at t0 + k/rate regardless of completions,
+so queueing delay is measured honestly — a closed loop would self-throttle)
+over graphs of several distinct sizes, then prints ONE BENCH-style JSON line:
+
+  {"metric": "serve_throughput", "value": <req/s>, "unit": "req/s",
+   "vs_baseline": null, "snapshot": {<ServeMetrics snapshot>}, ...}
+
+CPU works (JAX_PLATFORMS=cpu); the same harness runs unchanged on TPU.
+
+  python scripts/serve_bench.py --config_path configs/nbody_serve.yaml \
+      --requests 64 --rate 200 --sizes 48,96,192
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _build(cfg, sizes, seed):
+    import jax
+
+    from distegnn_tpu.models.registry import get_model
+    from distegnn_tpu.serve import engine_from_config, synthetic_graph
+
+    model = get_model(cfg.model, dataset_name=cfg.data.dataset_name)
+    feat_nf = int(cfg.model.node_feat_nf)
+    edge_nf = int(cfg.model.edge_attr_nf)
+    graphs = [synthetic_graph(n, seed=seed + i, feat_nf=feat_nf,
+                              edge_attr_nf=edge_nf)
+              for i, n in enumerate(sizes)]
+    engine, q = engine_from_config(cfg, model, params=None)
+    b0 = engine.ladder.bucket_of_graph(graphs[0])
+    init_batch, _ = engine.ladder.pad_batch([graphs[0]], b0, 1)
+    engine.params = model.init(jax.random.PRNGKey(seed), init_batch)
+    return engine, q, graphs
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description="serve-stack open-loop bench")
+    ap.add_argument("--config_path", type=str, default=None,
+                    help="YAML with a serve: section (default: built-ins)")
+    ap.add_argument("--requests", type=int, default=64)
+    ap.add_argument("--rate", type=float, default=200.0,
+                    help="arrival rate, req/s (open loop)")
+    ap.add_argument("--sizes", type=str, default="48,96,192",
+                    help="comma-separated node counts of the synthetic mix")
+    ap.add_argument("--seed", type=int, default=43)
+    ap.add_argument("--no-warmup", action="store_true",
+                    help="include first-request compiles in the timed window")
+    args = ap.parse_args(argv)
+
+    from distegnn_tpu.config import ConfigDict, _DEFAULTS, load_config
+
+    cfg = (load_config(args.config_path) if args.config_path
+           else ConfigDict(_DEFAULTS))
+    sizes = [int(s) for s in args.sizes.split(",") if s]
+    engine, q, graphs = _build(cfg, sizes, args.seed)
+
+    if not args.no_warmup:
+        engine.warmup([(g["loc"].shape[0], g["edge_index"].shape[1])
+                       for g in graphs])
+
+    futures, rejected = [], 0
+    t0 = time.perf_counter()
+    with q:
+        for k in range(args.requests):
+            target = t0 + k / args.rate
+            delay = target - time.perf_counter()
+            if delay > 0:
+                time.sleep(delay)
+            try:
+                futures.append(q.submit(graphs[k % len(graphs)]))
+            except Exception:  # QueueFullError: open loop sheds, keeps going
+                rejected += 1
+        for f in futures:
+            try:
+                f.result(timeout=60.0)
+            except Exception:
+                pass  # failures are visible in the snapshot counters
+    wall = time.perf_counter() - t0
+
+    snap = engine.metrics.snapshot()
+    completed = snap["requests_completed"]
+    rec = {
+        "metric": "serve_throughput",
+        "value": round(completed / max(wall, 1e-9), 3),
+        "unit": "req/s",
+        "vs_baseline": None,
+        "requests": args.requests,
+        "rejected_at_submit": rejected,
+        "offered_rate": args.rate,
+        "sizes": sizes,
+        "wall_s": round(wall, 4),
+        "platform": __import__("jax").default_backend(),
+        "snapshot": snap,
+    }
+    print(json.dumps(rec, sort_keys=True))
+    return 0 if completed else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -14,7 +14,16 @@ os.environ.setdefault("JAX_PLATFORMS", "cpu")
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
-jax.config.update("jax_num_cpu_devices", 8)
+try:
+    jax.config.update("jax_num_cpu_devices", 8)
+except AttributeError:
+    # jax < 0.5: the option doesn't exist; the XLA flag is read when the CPU
+    # backend initializes (first device use), which hasn't happened yet even
+    # though jax is imported — so the env route still works here.
+    _flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in _flags:
+        os.environ["XLA_FLAGS"] = (
+            _flags + " --xla_force_host_platform_device_count=8").strip()
 jax.config.update("jax_default_matmul_precision", "highest")
 
 import numpy as np  # noqa: E402
@@ -23,6 +32,9 @@ import pytest  # noqa: E402
 
 def pytest_configure(config):
     config.addinivalue_line("markers", "slow: long-running test (multi-process spawns)")
+    # serve tests are tier-1 (NOT slow): CPU-only via JAX_PLATFORMS=cpu, the
+    # queue/batcher exercised fully in-process — no network sockets
+    config.addinivalue_line("markers", "serve: serving-stack tests (distegnn_tpu/serve)")
 
 
 @pytest.fixture

@@ -16,12 +16,16 @@ Layouts (docs/PERFORMANCE.md):
   blocked      — blocked-CSR layout, one-hot contraction ops (ops/blocked.py;
                  --impl einsum|pallas selects the lowering); hardware-measured
                  slower than plain, kept for explicit runs only
-Default is auto: race the production candidates — fused+reordered scatter
-(f32 and bf16 aggregation streams), the Pallas-prefix cumsum lowering (bf16
-and f32), and the unfused/unreordered anchor control — each in a child
-process (so a compiler surprise on new hardware cannot take down the
-bench), and report the fastest real measurement. ELL and both blocked
-generations are hardware-refuted (BASELINE.md 2026-08-02) and retired.
+  fused        — blocked layout consumed by the fused edge-pipeline Pallas
+                 kernel (model.edge_impl='fused', ops/edge_pipeline.py): one
+                 streamed pass per layer over the in-window edges + a compact
+                 remote tail through plain ops (docs/PERFORMANCE.md)
+Default is auto: race the production candidates in RACE_ORDER — the fused
+edge pipeline first, then cumsum/remat/agg-dtype stacks and the
+unfused/unreordered anchor control — each in a child process (so a compiler
+surprise on new hardware cannot take down the bench), and report the fastest
+real measurement. ELL and both blocked generations are hardware-refuted
+(BASELINE.md 2026-08-02) and retired.
 
 Timing methodology (v2, round 2 — see BASELINE.md "Measurement integrity"):
 round 1 timed a donated jit with jax.block_until_ready, which RETURNS EARLY
@@ -88,6 +92,29 @@ RACE_ARTIFACT_CPU = os.path.join("docs", "artifacts", "bench_race_cpu_last.json"
 # CONTs any leftover stopped PIDs from it on startup (ADVICE r3, medium).
 PAUSED_PIDS_FILE = "/tmp/bench_paused.pids"
 
+# Auto-race order, one (child argv, extra env) tuple per leg. Rewritten after
+# the round-4 session-B contended race (BASELINE.md,
+# bench_race_20260802b_contended.json): in-session, cumsum+aggbf16 beat plain
+# 1.81x and remat alone beat it 1.65x. The UNMEASURED-on-hardware fused edge
+# pipeline goes FIRST — its whole design is to beat the best measured leg on
+# HBM traffic (one streamed pass per layer, docs/PERFORMANCE.md), so it is
+# the highest-information leg if the session dies early. Then the best
+# measured stack guess (cumsum+aggbf16+remat), the measured session-B winner,
+# the two single-knob legs that tie this session to session B's ratios, and
+# the legacy anchor control (unfused, unreordered scatter — ties the session
+# to the committed round-1 anchor). ELL (0.633x) and both blocked generations
+# (0.784x, 0.446x) are hardware-refuted and retired.
+# tests/test_bench_unlosable.py traces EVERY leg here on CPU.
+RACE_ORDER = (
+    (["--layout", "fused"], None),
+    (["--layout", "plain", "--seg", "cumsum"],
+     {"BENCH_AGG_DTYPE": "bf16", "BENCH_REMAT": "1"}),
+    (["--layout", "plain", "--seg", "cumsum"], {"BENCH_AGG_DTYPE": "bf16"}),
+    (["--layout", "plain"], {"BENCH_REMAT": "1"}),
+    (["--layout", "plain"], None),
+    (["--layout", "plain", "--fuse", "0"], {"BENCH_REORDER": "0"}),
+)
+
 # TPU v5e peak: 197 TFLOP/s bf16, ~98.5 TFLOP/s fp32 (public spec sheet).
 PEAK_F32_FLOPS = 98.5e12
 # TPU v5e HBM2 bandwidth, public spec sheet. The step is memory-bound
@@ -97,7 +124,7 @@ PEAK_HBM_GBPS = 819.0
 
 
 def make_fluid_batch(rng, edge_block: int = 0, pairing: bool = False,
-                     edge_tile: int = 512):
+                     edge_tile: int = 512, split_remote: bool = False):
     """Synthetic fluid-like particle cloud at Fluid113K density."""
     from distegnn_tpu.ops.graph import pad_graphs
     from distegnn_tpu.ops.radius import radius_graph_np
@@ -130,7 +157,8 @@ def make_fluid_batch(rng, edge_block: int = 0, pairing: bool = False,
         "edge_index": edge_index,
         "edge_attr": np.repeat(dist[:, None], 2, axis=1).astype(np.float32),
     }
-    kw = ({"edge_block": edge_block, "edge_tile": edge_tile}
+    kw = ({"edge_block": edge_block, "edge_tile": edge_tile,
+           "split_remote": split_remote}
           if edge_block else {"compute_pair": pairing})
     return pad_graphs([graph], **kw), n_edges
 
@@ -212,16 +240,19 @@ def cpu_competitors():
     return pids, ambiguous
 
 
-def layout_tag(edge_block: int, impl: str, seg: str = "scatter") -> str:
+def layout_tag(edge_block: int, impl: str, seg: str = "scatter",
+               edge_impl: str = "plain") -> str:
     """The machine-read layout label shared by bench.py and profile_step.py
     outputs (pasted into BASELINE.md tables)."""
+    if edge_impl == "fused":
+        return f"fused{edge_block}"
     if edge_block:
         return f"blocked{edge_block}-{impl}"
     return "plain" if seg == "scatter" else f"plain-{seg}"
 
 
 def measure(edge_block: int, impl: str = "einsum", seg: str = "scatter",
-            fuse: bool = True):
+            fuse: bool = True, edge_impl: str = "plain"):
     import jax
 
     from distegnn_tpu.models.fast_egnn import FastEGNN
@@ -231,12 +262,13 @@ def measure(edge_block: int, impl: str = "einsum", seg: str = "scatter",
     edge_tile = _env_int("BENCH_EDGE_TILE", 512)
     batch, n_edges = make_fluid_batch(rng, edge_block,
                                       pairing=(seg in ("cumsum", "ell")),
-                                      edge_tile=edge_tile)
+                                      edge_tile=edge_tile,
+                                      split_remote=(edge_impl == "fused"))
 
     model = FastEGNN(node_feat_nf=3, node_attr_nf=2, edge_attr_nf=2,
                      hidden_nf=HIDDEN, virtual_channels=CHANNELS, n_layers=LAYERS,
                      compute_dtype="bf16", blocked_impl=impl, segment_impl=seg,
-                     fuse_agg=fuse,
+                     fuse_agg=fuse, edge_impl=edge_impl,
                      agg_dtype=os.environ.get("BENCH_AGG_DTYPE") or None,
                      # racing knob: without remat the backward re-reads ~10
                      # GiB of saved [E,.] activations — at the measured
@@ -277,7 +309,7 @@ def measure(edge_block: int, impl: str = "einsum", seg: str = "scatter",
 
     nodes_per_sec = N_NODES * STEPS / dt
     platform = jax.devices()[0].platform
-    layout = layout_tag(edge_block, impl, seg)
+    layout = layout_tag(edge_block, impl, seg, edge_impl)
     # self-describing record: the locality / fusion / stream-dtype knobs are
     # part of the measured configuration (VERDICT r3 #1 prepared attack)
     if edge_block and edge_tile != 512:
@@ -324,13 +356,13 @@ def main():
 
     args = sys.argv[1:]
     layout, impl, seg, fuse = "auto", "einsum", "scatter", True
-    usage = ("usage: bench.py [--layout plain|blocked|auto] "
+    usage = ("usage: bench.py [--layout plain|blocked|fused|auto] "
              "[--impl pallas|einsum] [--seg scatter|cumsum|ell] "
              "[--fuse 0|1]  (env: BENCH_REORDER, BENCH_AGG_DTYPE)")
     if "--layout" in args:
         i = args.index("--layout")
-        if i + 1 >= len(args) or args[i + 1] not in ("plain", "blocked", "auto",
-                                                     "probe"):
+        if i + 1 >= len(args) or args[i + 1] not in ("plain", "blocked", "fused",
+                                                     "auto", "probe"):
             sys.exit(usage)
         layout = args[i + 1]
     if "--impl" in args:
@@ -359,6 +391,12 @@ def main():
 
         x = jnp.ones((256, 256))
         print("PROBE_OK", jax.devices()[0].platform, float((x @ x).sum()))
+        return
+    if layout == "fused":
+        # fused edge pipeline: kernel constraints pin the block (>= 512 and a
+        # multiple of it); BENCH_FUSED_BLOCK overrides for VMEM-window sweeps
+        fb = _env_int("BENCH_FUSED_BLOCK", 512)
+        print(json.dumps(measure(fb, impl, seg, fuse, edge_impl="fused")))
         return
     if layout in ("plain", "blocked"):
         print(json.dumps(measure(edge_block if layout == "blocked" else 0,
@@ -416,7 +454,11 @@ def main():
                 repo_dir, RACE_ARTIFACT if to_main else RACE_ARTIFACT_CPU)
             tmp = path + ".tmp"
             with open(tmp, "w") as f:
-                json.dump({"probe_ok": probe_ok, "platform": platform,
+                json.dump({"DO_NOT_CITE": "rolling file, overwritten by "
+                                          "every race — cite the dated "
+                                          "docs/artifacts/bench_*_<stamp> "
+                                          "archives instead",
+                           "probe_ok": probe_ok, "platform": platform,
                            "on_hardware": on_hardware, "n_nodes": N_NODES,
                            "note": "single-session race; values comparable "
                                    "only within this record (2.2x "
@@ -550,23 +592,9 @@ def main():
     best, records, fails = None, [], []
     first = True
     try:
-        # Race order, rewritten after the round-4 session-B contended race
-        # (BASELINE.md, bench_race_20260802b_contended.json): in-session,
-        # cumsum+aggbf16 beat plain 1.81x and remat alone beat it 1.65x —
-        # so the unmeasured stack of both goes FIRST (best headline guess),
-        # then the measured session-B winner, then the two single-knob legs
-        # that tie this session to session B's ratios, then the legacy
-        # anchor control (unfused, unreordered scatter — ties the session to
-        # the committed round-1 anchor). ELL (0.633x) and both blocked
-        # generations (0.784x, 0.446x) are hardware-refuted and retired.
-        for child_args, child_env in (
-                (["--layout", "plain", "--seg", "cumsum"],
-                 {"BENCH_AGG_DTYPE": "bf16", "BENCH_REMAT": "1"}),
-                (["--layout", "plain", "--seg", "cumsum"],
-                 {"BENCH_AGG_DTYPE": "bf16"}),
-                (["--layout", "plain"], {"BENCH_REMAT": "1"}),
-                (["--layout", "plain"], None),
-                (["--layout", "plain", "--fuse", "0"], {"BENCH_REORDER": "0"})):
+        # Race order lives in RACE_ORDER (module top) so the CPU trace test
+        # and hw_session.sh stage the exact legs this loop runs.
+        for child_args, child_env in RACE_ORDER:
             # Skip rather than admit a child that could only finish by being
             # timeout-killed: a timeout SIGKILLs a LIVE client
             # mid-measurement, which strands the remote claim (the

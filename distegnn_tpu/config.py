@@ -91,6 +91,12 @@ _DEFAULTS: dict = {
         # dominant read bytes; f32 accumulation; rounds geometry columns —
         # measured opt-in, see docs/PERFORMANCE.md round-4 attack)
         "agg_dtype": None,
+        # real-edge lowering (FastEGNN): 'plain' (EdgeOps streams, any
+        # layout) or 'fused' (one Pallas pass per layer over the blocked
+        # in-window edges + compact remote tail, ops/edge_pipeline).
+        # 'fused' requires data.edge_block >= 512 (multiple of 512) and
+        # edge_attr_nf == 2; loaders then build split_remote batches.
+        "edge_impl": "plain",
     },
     "data": {
         "data_dir": "./data",
@@ -291,6 +297,23 @@ def validate_config(cfg: ConfigDict) -> None:
         raise ValueError("train.accumulation_steps must be >= 1")
     if cfg.model.virtual_channels < 1:
         raise ValueError("model.virtual_channels must be >= 1")
+    edge_impl = cfg.model.get("edge_impl", "plain")
+    if edge_impl not in ("plain", "fused"):
+        raise ValueError("model.edge_impl must be 'plain' or 'fused'")
+    if edge_impl == "fused":
+        from distegnn_tpu.ops.edge_pipeline import OH_CHUNK
+
+        blk = int(cfg.data.edge_block)
+        if blk < OH_CHUNK or blk % OH_CHUNK:
+            raise ValueError(
+                f"model.edge_impl='fused' requires data.edge_block >= {OH_CHUNK} "
+                f"and a multiple of {OH_CHUNK} (got {blk})")
+        if int(cfg.model.edge_attr_nf) != 2:
+            raise ValueError("model.edge_impl='fused' requires edge_attr_nf == 2 "
+                             "(the kernel's scalar lane layout is fixed)")
+        if bool(cfg.model.normalize):
+            raise ValueError("model.edge_impl='fused' does not support "
+                             "model.normalize (flagship EGCL only)")
     s = cfg.get("serve")
     if s is None:
         return  # hand-built config without the serving section

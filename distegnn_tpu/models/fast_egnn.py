@@ -24,11 +24,41 @@ import jax.numpy as jnp
 from flax import linen as nn
 
 from distegnn_tpu.models.common import (
-    MLP, CoordMLP, HoistedEdgeMLP, TorchDense, resolve_dtype,
+    MLP, CoordMLP, HoistedEdgeMLP, TorchDense, _torch_bias_init,
+    coord_head_init, gather_nodes, resolve_dtype, torch_linear_init,
 )
 from distegnn_tpu.ops.blocked import EdgeOps, blocked_slot_inv_deg
+from distegnn_tpu.ops.edge_pipeline import (EdgeWeights, build_edge_blocks,
+                                            fused_edge_layer)
 from distegnn_tpu.ops.graph import GraphBatch
 from distegnn_tpu.parallel.collectives import global_node_mean
+
+
+class FusedEdgeParams(nn.Module):
+    """Raw phi_e + phi_x parameters for ``edge_impl='fused'``.
+
+    Same shapes and init variances as the hoisted plain path (HoistedEdgeMLP
+    ``phi_e`` + CoordMLP ``phi_x``), declared as raw arrays because both the
+    Pallas kernel (ops/edge_pipeline.EdgeWeights) and the compact remote tail
+    consume the weights directly. Like ``hoist_edge_mlp``, flipping
+    ``edge_impl`` changes the param tree — checkpoints are not compatible
+    across the flag (tests/test_fused_model.py remaps between them)."""
+
+    hidden_nf: int
+    scalar_nf: int           # per-edge scalars: radial + edge_attr
+
+    @nn.compact
+    def __call__(self):
+        H, S = self.hidden_nf, self.scalar_nf
+        fan1 = 2 * H + S
+        w1 = self.param("w1", torch_linear_init, (fan1, H), jnp.float32)
+        b1 = self.param("b1", _torch_bias_init(fan1), (H,), jnp.float32)
+        w2 = self.param("w2", torch_linear_init, (H, H), jnp.float32)
+        b2 = self.param("b2", _torch_bias_init(H), (H,), jnp.float32)
+        w3 = self.param("w3", torch_linear_init, (H, H), jnp.float32)
+        b3 = self.param("b3", _torch_bias_init(H), (H,), jnp.float32)
+        w4 = self.param("w4", coord_head_init, (H, 1), jnp.float32)
+        return w1, b1, w2, b2, w3, b3, w4
 
 
 class EGCLVel(nn.Module):
@@ -74,6 +104,11 @@ class EGCLVel(nn.Module):
     # TRANSLATIONS — equivariance becomes approximate at bf16 noise level.
     # Measured opt-in (VERDICT r3 #1), None = f32.
     agg_dtype: Optional[str] = None
+    # real-edge lowering: 'plain' = per-edge streams through EdgeOps (any
+    # layout), 'fused' = ONE Pallas pass per layer over the blocked in-window
+    # edges (ops/edge_pipeline) plus a dense remote tail — needs a blocked
+    # batch built with split_remote=True and edge_block >= 512
+    edge_impl: str = "plain"
 
     @nn.compact
     def __call__(
@@ -88,6 +123,7 @@ class EGCLVel(nn.Module):
         slot: Optional[jnp.ndarray] = None,     # [B, E] blocked-layout slots
         inv_deg: Optional[jnp.ndarray] = None,  # [B, N, 1] 1/max(in-degree, 1)
         oh: Optional[jnp.ndarray] = None,       # [B, nb, epb, block] einsum incidence
+        fused_arrs: Optional[Tuple] = None,     # batched build_edge_blocks output
     ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray, jnp.ndarray]:
         H, C = self.hidden_nf, self.virtual_channels
         dt = resolve_dtype(self.compute_dtype)
@@ -96,33 +132,100 @@ class EGCLVel(nn.Module):
         nm = node_mask[..., None]
         ops = EdgeOps(g, slot, inv_deg, oh, seg_impl=self.seg_impl)
 
-        # --- real-edge geometry (reference coord2radial, :237-246)
-        coord_diff = ops.gather_rows(x) - ops.gather_cols(x)            # [B, E, 3]
-        radial = jnp.sum(coord_diff**2, axis=-1, keepdims=True)         # [B, E, 1]
-        if self.normalize:
-            norm = jax.lax.stop_gradient(jnp.sqrt(radial)) + self.epsilon
-            coord_diff = coord_diff / norm
+        # --- real-edge lowering: 'plain' materializes per-edge streams via
+        # EdgeOps; 'fused' runs one Pallas pass over the blocked in-window
+        # edges + a dense remote tail and yields aggregated [B, N, ...]
+        # results directly (no per-edge intermediate ever touches HBM)
+        if self.edge_impl not in ("plain", "fused"):
+            raise ValueError(f"unknown edge_impl {self.edge_impl!r}")
+        if self.coords_agg not in ("sum", "mean"):
+            raise ValueError(f"Wrong coords_agg parameter {self.coords_agg!r}")
+        fused = self.edge_impl == "fused"
+        agg = agg_h_f = None
+        if fused:
+            if self.attention or self.normalize or self.tanh:
+                raise ValueError(
+                    "edge_impl='fused' supports the flagship EGCL only: "
+                    "attention/normalize/tanh are baked out of the kernel — "
+                    "use edge_impl='plain' with those heads")
+            if self.edge_attr_nf != 2:
+                raise ValueError(
+                    f"edge_impl='fused' requires edge_attr_nf=2 (the kernel "
+                    f"scalar lanes are [radial, attr0, attr1]); got "
+                    f"{self.edge_attr_nf}")
+            if fused_arrs is None or g.remote_edge_index is None:
+                raise ValueError(
+                    "edge_impl='fused' needs a blocked batch built with "
+                    "split_remote=True plus the hoisted build_edge_blocks "
+                    "arrays (FastEGNN passes them) — check data.edge_block "
+                    "and the loader's split_remote flag")
+            w1, b1, w2, b2, w3, b3, w4 = FusedEdgeParams(
+                H, 1 + self.edge_attr_nf, name="phi_e_fused")()
+            c = (lambda a: a.astype(dt)) if dt is not None else (lambda a: a)
+            hr = c(h) @ c(w1[:H])          # hoisted node-axis products
+            hc = c(h) @ c(w1[H:2 * H])     # (HoistedEdgeMLP algebra)
+            kw = EdgeWeights(ws=w1[2 * H:], b1=b1[None], w2=w2, b2=b2[None],
+                             w3=w3, b3=b3[None], w4=w4.T)
+            dname = "bf16" if dt is jnp.bfloat16 else "f32"
+            row_t, col_l, kblk, scal = fused_arrs
+            outs = [fused_edge_layer(x[b], hr[b], hc[b], row_t[b], col_l[b],
+                                     kblk[b], scal[b], kw, g.edge_block, dname)
+                    for b in range(h.shape[0])]
+            trans_sum = jnp.stack([o[0] for o in outs])          # [B, N, 3]
+            count = jnp.stack([o[1] for o in outs])              # [B, N]
+            ef_sum = jnp.stack([o[2] for o in outs])             # [B, N, H]
+
+            # remote tail (~5-8% of E): identical math, dense over the
+            # compact out-of-window edge list carried on the batch
+            rr, rc = g.remote_edge_index[:, 0], g.remote_edge_index[:, 1]
+            rm = g.remote_edge_mask[..., None]                   # [B, R, 1]
+            cd_r = (gather_nodes(x, rr) - gather_nodes(x, rc)) * rm
+            radial_r = jnp.sum(cd_r * cd_r, axis=-1, keepdims=True)
+            sfeat = c(jnp.concatenate(
+                [radial_r, g.remote_edge_attr[..., :2]], axis=-1))
+            t1 = (gather_nodes(hr, rr) + gather_nodes(hc, rc)
+                  + sfeat @ c(w1[2 * H:]) + c(b1))
+            ef_r = nn.silu(nn.silu(t1) @ c(w2) + c(b2))          # [B, R, H]
+            y2 = nn.silu(ef_r @ c(w3) + c(b3))
+            g_r = (y2.astype(jnp.float32) @ w4) * rm             # [B, R, 1]
+            N_ = x.shape[1]
+            seg = jax.vmap(
+                lambda val, r: jax.ops.segment_sum(val, r, num_segments=N_))
+            trans_sum = trans_sum + seg(cd_r * g_r, rr)
+            count = count + seg(g.remote_edge_mask, rr)
+            ef_sum = ef_sum + seg(ef_r.astype(jnp.float32) * rm, rr)
+
+            cnt = jnp.maximum(count, 1.0)[..., None]
+            agg = trans_sum / cnt if self.coords_agg == "mean" else trans_sum
+            agg_h_f = ef_sum / cnt
+        else:
+            # --- real-edge geometry (reference coord2radial, :237-246)
+            coord_diff = ops.gather_rows(x) - ops.gather_cols(x)        # [B, E, 3]
+            radial = jnp.sum(coord_diff**2, axis=-1, keepdims=True)     # [B, E, 1]
+            if self.normalize:
+                norm = jax.lax.stop_gradient(jnp.sqrt(radial)) + self.epsilon
+                coord_diff = coord_diff / norm
+
+            # --- real edge messages phi_e (:144-150)
+            if self.hoist_edge_mlp:
+                scalars = (jnp.concatenate([radial, g.edge_attr], axis=-1)
+                           if self.edge_attr_nf else radial)
+                edge_feat = HoistedEdgeMLP(H, 1 + self.edge_attr_nf,
+                                           name="phi_e", dtype=dt)(h, scalars, ops)
+            else:
+                e_in = [ops.gather_rows(h), ops.gather_cols(h), radial]
+                if self.edge_attr_nf:
+                    e_in.append(g.edge_attr)
+                edge_feat = MLP([H, H], act_last=True, name="phi_e", dtype=dt)(
+                    jnp.concatenate(e_in, axis=-1))
+            if self.attention:
+                gate_e = jax.nn.sigmoid(TorchDense(1, name="att", dtype=dt)(edge_feat))
+                edge_feat = edge_feat * gate_e                           # [B, E, H]
+            edge_feat = edge_feat * edge_mask[..., None].astype(edge_feat.dtype)
 
         # --- virtual-edge geometry (:252-253): every node sees all C virtual nodes
         vcd = X[:, None, :, :] - x[..., None]                           # [B, N, 3, C]
         virtual_radial = jnp.linalg.norm(vcd, axis=2, keepdims=True)    # [B, N, 1, C]
-
-        # --- real edge messages phi_e (:144-150)
-        if self.hoist_edge_mlp:
-            scalars = (jnp.concatenate([radial, g.edge_attr], axis=-1)
-                       if self.edge_attr_nf else radial)
-            edge_feat = HoistedEdgeMLP(H, 1 + self.edge_attr_nf,
-                                       name="phi_e", dtype=dt)(h, scalars, ops)
-        else:
-            e_in = [ops.gather_rows(h), ops.gather_cols(h), radial]
-            if self.edge_attr_nf:
-                e_in.append(g.edge_attr)
-            edge_feat = MLP([H, H], act_last=True, name="phi_e", dtype=dt)(
-                jnp.concatenate(e_in, axis=-1))
-        if self.attention:
-            gate_e = jax.nn.sigmoid(TorchDense(1, name="att", dtype=dt)(edge_feat))
-            edge_feat = edge_feat * gate_e                               # [B, E, H]
-        edge_feat = edge_feat * edge_mask[..., None].astype(edge_feat.dtype)
 
         # ---------- psum #1: exact global coordinate mean (:258-261)
         coord_mean = global_node_mean(x, node_mask, self.axis_name)     # [B, 3]
@@ -148,20 +251,20 @@ class EGCLVel(nn.Module):
             vef = vef * gate
         vef = vef * node_mask[:, :, None, None].astype(vef.dtype)        # zero padded nodes
 
-        # --- real coordinate update (coord_model_vel, :166-188)
-        if self.coords_agg not in ("sum", "mean"):
-            raise ValueError(f"Wrong coords_agg parameter {self.coords_agg!r}")
-        trans = coord_diff * CoordMLP(H, tanh=self.tanh, name="phi_x", dtype=dt)(edge_feat)  # [B, E, 3]
-        if self.fuse_agg:
-            # both per-layer aggregations (+ the count) in ONE pass (blocked
-            # layouts keep two calls inside but honor the agg_dtype knob)
-            agg, agg_h_f = ops.agg_rows_pair(
-                trans, edge_feat, a_mean=(self.coords_agg == "mean"),
-                agg_dtype=self.agg_dtype)
-        else:
-            agg = (ops.agg_rows_sum(trans) if self.coords_agg == "sum"
-                   else ops.agg_rows_mean(trans))                        # [B, N, 3]
-            agg_h_f = None
+        # --- real coordinate update (coord_model_vel, :166-188); the fused
+        # path already holds the aggregated translations in `agg`
+        if not fused:
+            trans = coord_diff * CoordMLP(H, tanh=self.tanh, name="phi_x", dtype=dt)(edge_feat)  # [B, E, 3]
+            if self.fuse_agg:
+                # both per-layer aggregations (+ the count) in ONE pass (blocked
+                # layouts keep two calls inside but honor the agg_dtype knob)
+                agg, agg_h_f = ops.agg_rows_pair(
+                    trans, edge_feat, a_mean=(self.coords_agg == "mean"),
+                    agg_dtype=self.agg_dtype)
+            else:
+                agg = (ops.agg_rows_sum(trans) if self.coords_agg == "sum"
+                       else ops.agg_rows_mean(trans))                    # [B, N, 3]
+                agg_h_f = None
         x = x + agg
 
         phi_xv = CoordMLP(H, tanh=self.tanh, name="phi_xv", dtype=dt)(vef)  # [B, N, C, 1]
@@ -236,6 +339,11 @@ class FastEGNN(nn.Module):
     remat: bool = False
     fuse_agg: bool = True          # packed per-layer aggregation (EGCLVel)
     agg_dtype: Optional[str] = None  # 'bf16' packed-aggregation stream (EGCLVel)
+    # real-edge lowering (EGCLVel): 'plain' or 'fused' (single Pallas pass
+    # per layer over the blocked in-window edges, ops/edge_pipeline). Fused
+    # requires a blocked batch (edge_block >= 512, multiple of 512, N >= 3
+    # blocks) built with split_remote=True, and edge_attr_nf == 2.
+    edge_impl: str = "plain"
 
     @nn.compact
     def __call__(self, g: GraphBatch) -> Tuple[jnp.ndarray, jnp.ndarray]:
@@ -257,6 +365,19 @@ class FastEGNN(nn.Module):
         # shared by all layers
         slot, inv_deg, oh = blocked_slot_inv_deg(g, self.blocked_impl)
 
+        # fused edge pipeline: the kernel's blocked HBM layout of the edge
+        # stream is layer-invariant too — build it once per forward
+        fused_arrs = None
+        if self.edge_impl == "fused":
+            if g.edge_block <= 0:
+                raise ValueError(
+                    "edge_impl='fused' requires a blocked batch "
+                    "(data.edge_block >= 512, a multiple of 512)")
+            fused_arrs = jax.vmap(
+                lambda r, c, ea, em: build_edge_blocks(
+                    r, c, ea, em, block=g.edge_block, n_nodes=g.max_nodes)
+            )(g.row, g.col, g.edge_attr, g.edge_mask)
+
         layer_cls = nn.remat(EGCLVel) if self.remat else EGCLVel
         for i in range(self.n_layers):
             h, x, Hv, X = layer_cls(
@@ -275,8 +396,9 @@ class FastEGNN(nn.Module):
                 seg_impl=self.segment_impl,
                 fuse_agg=self.fuse_agg,
                 agg_dtype=self.agg_dtype,
+                edge_impl=self.edge_impl,
                 name=f"gcl_{i}",
             )(h, x, v, X, Hv, g, gravity=gravity, slot=slot, inv_deg=inv_deg,
-              oh=oh)
+              oh=oh, fused_arrs=fused_arrs)
 
         return x, X

@@ -53,6 +53,7 @@ def get_model(model_config, world_size: int = 1, dataset_name: Optional[str] = N
             segment_impl=model_config.get("segment_impl", "scatter"),
             fuse_agg=bool(model_config.get("fuse_agg", True)),
             agg_dtype=model_config.get("agg_dtype"),
+            edge_impl=model_config.get("edge_impl", "plain"),
         )
     if name == "FastRF":
         FastRF = _import_model("fast_rf", "FastRF")

@@ -157,6 +157,25 @@ def build_edge_blocks(row, col, edge_attr, edge_mask, *, block, n_nodes):
     return row_t, col_l[:, None], kblk[:, None], scal
 
 
+def _remote_sel(edge_index: np.ndarray, block: int, n_nodes: int) -> np.ndarray:
+    """Boolean [e] mask of edges OUTSIDE the 3-block VMEM window — the single
+    definition of the remote classification (mirrors build_edge_blocks)."""
+    if n_nodes % block:
+        raise ValueError(f"n_nodes={n_nodes} not a multiple of block={block}")
+    row, col = edge_index[0], edge_index[1]
+    br, bc = row // block, col // block
+    nb = n_nodes // block
+    s = np.clip(br - 1, 0, max(nb - 3, 0))
+    return (bc < s) | (bc > s + 2)
+
+
+def count_remote_edges(edge_index: np.ndarray, *, block: int,
+                       n_nodes: int) -> int:
+    """Number of out-of-window edges (loader scans use this to pick a
+    dataset-stable remote pad without materializing the split)."""
+    return int(_remote_sel(np.asarray(edge_index), block, n_nodes).sum())
+
+
 def split_remote_edges(edge_index: np.ndarray, edge_attr: np.ndarray,
                        *, block: int, n_nodes: int,
                        n_pad: Optional[int] = None
@@ -175,13 +194,8 @@ def split_remote_edges(edge_index: np.ndarray, edge_attr: np.ndarray,
     remote_mask [Er]) padded to ``n_pad`` (default: next multiple of 128).
     Padding points at node 0 with mask 0 — the pad_graphs convention.
     """
-    if n_nodes % block:
-        raise ValueError(f"n_nodes={n_nodes} not a multiple of block={block}")
-    row, col = edge_index[0], edge_index[1]
-    br, bc = row // block, col // block
-    nb = n_nodes // block
-    s = np.clip(br - 1, 0, max(nb - 3, 0))
-    remote = (bc < s) | (bc > s + 2)
+    remote = _remote_sel(edge_index, block, n_nodes)
+    row = edge_index[0]
     r_idx = np.where(remote)[0]
     r_idx = r_idx[np.argsort(row[r_idx], kind="stable")]
     er = r_idx.size

@@ -127,13 +127,21 @@ class BucketLadder:
 
     # ---- padding ---------------------------------------------------------
     def pad_batch(self, graphs: Sequence[dict], bucket: Bucket,
-                  batch_pad: int) -> Tuple[GraphBatch, int]:
+                  batch_pad: int, *, edge_block: int = 0, edge_tile: int = 512,
+                  split_remote: bool = False) -> Tuple[GraphBatch, int]:
         """Pack ``graphs`` (all admitted by ``bucket``) into one GraphBatch
         of EXACTLY (batch_pad, bucket.n, bucket.e).
 
         The batch axis is padded by replicating the first graph — replicas
         are valid graphs (no NaN hazards from empty-graph means) and their
         outputs are simply discarded; returns (batch, n_real).
+
+        ``edge_block > 0`` emits the BLOCKED layout instead (the fused edge
+        pipeline's input; ``split_remote`` adds the compact out-of-window
+        list). Node count snaps up from bucket.n to a block multiple;
+        edges_per_block and the remote width auto-derive per batch — a
+        serving layer has no dataset to scan, so the ENGINE keys its compile
+        cache on the resulting batch shapes rather than on the rung alone.
         """
         n_real = len(graphs)
         if n_real == 0:
@@ -141,8 +149,16 @@ class BucketLadder:
         if n_real > batch_pad:
             raise ValueError(f"pad_batch: {n_real} graphs > batch_pad {batch_pad}")
         filled = list(graphs) + [graphs[0]] * (batch_pad - n_real)
-        batch = pad_graphs(filled, max_nodes=bucket.n, max_edges=bucket.e,
-                           node_bucket=1, edge_bucket=1)
+        if edge_block:
+            nb = (bucket.n + edge_block - 1) // edge_block
+            if split_remote:
+                nb = max(nb, 3)  # fused kernel's VMEM window spans 3 blocks
+            batch = pad_graphs(filled, max_nodes=nb * edge_block,
+                               edge_block=edge_block, edge_tile=edge_tile,
+                               compute_pair=False, split_remote=split_remote)
+        else:
+            batch = pad_graphs(filled, max_nodes=bucket.n, max_edges=bucket.e,
+                               node_bucket=1, edge_bucket=1)
         return batch, n_real
 
 

@@ -58,13 +58,18 @@ class InferenceEngine:
       donate: True | False | 'auto' (TPU only).
       rollout_opts: kwargs forwarded to make_rollout_fn (radius, max_degree,
         max_per_cell, edge_block, ...) — required for ``rollout``.
+      layout_opts: kwargs forwarded to ``ladder.pad_batch`` (edge_block,
+        edge_tile, split_remote) — a model with ``edge_impl='fused'`` needs
+        ``{'edge_block': 512, 'split_remote': True}`` so every served batch
+        carries the blocked layout + remote tail.
     """
 
     def __init__(self, model, params, *, ladder: Optional[BucketLadder] = None,
                  max_batch: int = 8, cache_size: int = 32,
                  donate: Any = "auto", metrics: Optional[ServeMetrics] = None,
                  apply_fn: Optional[Callable] = None,
-                 rollout_opts: Optional[dict] = None):
+                 rollout_opts: Optional[dict] = None,
+                 layout_opts: Optional[dict] = None):
         if max_batch < 1:
             raise ValueError("max_batch must be >= 1")
         if cache_size < 1:
@@ -78,6 +83,7 @@ class InferenceEngine:
         self._apply_fn = apply_fn or (
             lambda p, batch: model.apply(p, batch)[0])
         self._rollout_opts = dict(rollout_opts or {})
+        self._layout_opts = dict(layout_opts or {})
         if donate == "auto":
             donate = jax.default_backend() == "tpu"
         self._donate = bool(donate)
@@ -129,8 +135,16 @@ class InferenceEngine:
             bs = [self.ladder.bucket_of_graph(g) for g in graphs]
             # elementwise max: the rung admitting every graph on BOTH axes
             bucket = Bucket(max(b.n for b in bs), max(b.e for b in bs))
-        batch, n_real = self.ladder.pad_batch(graphs, bucket, self.max_batch)
-        fn = self._compiled(("predict", bucket.n, bucket.e, self.max_batch),
+        batch, n_real = self.ladder.pad_batch(graphs, bucket, self.max_batch,
+                                              **self._layout_opts)
+        # key on the RESULTING shapes, not the rung: blocked layouts derive
+        # edges_per_block / remote width per batch, and two rungs that pad to
+        # the same shapes may share one executable (plain layout keys reduce
+        # to the old (bucket.n, bucket.e, max_batch) triple)
+        rpad = (batch.remote_edge_mask.shape[-1]
+                if batch.remote_edge_mask is not None else 0)
+        fn = self._compiled(("predict", batch.max_nodes, batch.max_edges,
+                             batch.edge_block, rpad, self.max_batch),
                             lambda: self._build_predict(bucket))
         x = np.asarray(fn(self.params, batch))           # [max_batch, N, 3]
         return [x[i, : graphs[i]["loc"].shape[0]].copy()

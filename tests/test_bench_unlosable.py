@@ -5,10 +5,15 @@ first. The contract now: after EVERY finished leg bench.py prints the
 best-so-far headline JSON line (flushed), so killing the process at ANY
 point after >=1 finished leg leaves a parseable headline in the captured
 tail. This test runs a tiny CPU race, waits for the first headline line,
-SIGKILLs the bench mid-race, and parses what was captured."""
+SIGKILLs the bench mid-race, and parses what was captured.
+
+A second gate traces EVERY leg of bench.RACE_ORDER on CPU: a leg that cannot
+even build its jitted step on a dev box would burn a hardware session slot
+to discover the same crash (the round-2 failure mode)."""
 
 from __future__ import annotations
 
+import importlib.util
 import json
 import os
 import shutil
@@ -19,6 +24,37 @@ import sys
 import pytest
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _race_order():
+    spec = importlib.util.spec_from_file_location(
+        "bench_under_test", os.path.join(REPO, "bench.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod.RACE_ORDER
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("child_args,child_env", _race_order(),
+                         ids=lambda v: " ".join(v) if isinstance(v, list)
+                         else str(v))
+def test_every_race_leg_traces_on_cpu(child_args, child_env):
+    """Each race leg must run end-to-end (trace + execute one tiny step
+    program) on CPU — same child invocation the auto race spawns."""
+    env = dict(
+        os.environ,
+        BENCH_PLATFORM="cpu",
+        BENCH_PAUSE="0",
+        BENCH_NODES="1500",
+        JAX_PLATFORMS="cpu",
+        **(child_env or {}),
+    )
+    out = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bench.py")] + child_args,
+        capture_output=True, text=True, timeout=600, cwd=REPO, env=env)
+    assert out.returncode == 0, f"leg {child_args} died: {out.stderr[-800:]}"
+    rec = json.loads(out.stdout.strip().splitlines()[-1])
+    assert rec["value"] > 0 and "nodes/sec" in rec["unit"]
 
 
 @pytest.mark.slow

@@ -147,6 +147,22 @@ _DEFAULTS: dict = {
         # over a device-resident dataset (train/scan_epoch.py). 'auto' enables
         # it for single-process cutoff_edges runs whose dataset fits in HBM.
         "scan_epochs": "auto",
+        # resilience layer (docs/ROBUSTNESS.md):
+        # resume: null (fresh run) | 'auto' (scan log.log_dir for the newest
+        # CHECKSUM-VALID checkpoint, skipping corrupt/truncated files) | an
+        # explicit checkpoint path (fails loudly if corrupt)
+        "resume": None,
+        # mid-epoch wall-clock checkpoint cadence in seconds (0 = only the
+        # best/last eval-epoch saves); step_<n>.ckpt files rotate, keeping
+        # the newest keep_checkpoints
+        "checkpoint_interval_s": 0,
+        "keep_checkpoints": 3,
+        # non-finite epoch loss: roll back to the last finite state, multiply
+        # the LR by divergence_lr_decay, retry — up to divergence_retries
+        # times before declaring the run dead in log.json (0 = old
+        # stop-on-NaN behavior)
+        "divergence_retries": 2,
+        "divergence_lr_decay": 0.5,
     },
     # serving layer (distegnn_tpu/serve, docs/SERVING.md) — the bucket
     # ladder, micro-batcher, and compile cache of the inference engine
@@ -238,6 +254,8 @@ _CLI_FIELDS = {
     "world_size": ("data.world_size", int),
     # TPU-only extension: mesh data axis size (not a reference flag)
     "data_parallel": ("data.data_parallel", int),
+    # resilience: 'auto' or an explicit checkpoint path (train.resume)
+    "resume": ("train.resume", str),
 }
 
 
@@ -295,6 +313,17 @@ def validate_config(cfg: ConfigDict) -> None:
         raise ValueError("data.cutoff_rate must be in [0, 1)")
     if cfg.train.accumulation_steps < 1:
         raise ValueError("train.accumulation_steps must be >= 1")
+    resume = cfg.train.get("resume")
+    if resume is not None and not isinstance(resume, str):
+        raise ValueError("train.resume must be null, 'auto', or a checkpoint path")
+    if float(cfg.train.get("checkpoint_interval_s", 0) or 0) < 0:
+        raise ValueError("train.checkpoint_interval_s must be >= 0")
+    if int(cfg.train.get("keep_checkpoints", 3)) < 1:
+        raise ValueError("train.keep_checkpoints must be >= 1")
+    if int(cfg.train.get("divergence_retries", 0) or 0) < 0:
+        raise ValueError("train.divergence_retries must be >= 0")
+    if not 0.0 < float(cfg.train.get("divergence_lr_decay", 0.5)) <= 1.0:
+        raise ValueError("train.divergence_lr_decay must be in (0, 1]")
     if cfg.model.virtual_channels < 1:
         raise ValueError("model.virtual_channels must be >= 1")
     edge_impl = cfg.model.get("edge_impl", "plain")

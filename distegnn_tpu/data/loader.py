@@ -11,12 +11,41 @@ the TPU-first replacement for ragged PyG batching.
 from __future__ import annotations
 
 import pickle
+import time
 from typing import List, Optional, Sequence, Union
 
 import numpy as np
 import jax
 
 from distegnn_tpu.ops.graph import GraphBatch, _round_up, pad_graphs
+
+# module-level open hook: the fault-injection harness (testing/faults.py
+# flaky_open) swaps this to exercise the retry path without touching a real
+# filesystem fault
+_file_open = open
+
+# bounded retry around dataset file opens: epoch-start reads off NFS/GCS see
+# transient ESTALE/EIO-style hiccups, and a multi-hour unattended session
+# (scripts/convergence_session.sh) must not die to one
+_OPEN_ATTEMPTS = 3
+_OPEN_BACKOFF_S = 0.1
+
+
+def _open_with_retry(path: str, mode: str = "rb"):
+    """``open`` with ``_OPEN_ATTEMPTS`` tries and exponential backoff
+    (0.1s, 0.2s, ...); each retry is logged. The final failure propagates —
+    a genuinely missing/unreadable file is still a hard error."""
+    for attempt in range(_OPEN_ATTEMPTS):
+        try:
+            return _file_open(path, mode)
+        except OSError as e:
+            if attempt == _OPEN_ATTEMPTS - 1:
+                raise
+            delay = _OPEN_BACKOFF_S * (2 ** attempt)
+            print(f"loader: open {path} failed ({e!r}); retry "
+                  f"{attempt + 1}/{_OPEN_ATTEMPTS - 1} in {delay:.1f}s",
+                  flush=True)
+            time.sleep(delay)
 
 
 class GraphDataset:
@@ -26,7 +55,7 @@ class GraphDataset:
     def __init__(self, source: Union[str, Sequence[dict]],
                  node_order: str = "none"):
         if isinstance(source, str):
-            with open(source, "rb") as f:
+            with _open_with_retry(source, "rb") as f:
                 self.graphs: List[dict] = pickle.load(f)
         else:
             self.graphs = list(source)

@@ -40,6 +40,7 @@ from distegnn_tpu.train import (
     restore_checkpoint,
     train,
 )
+from distegnn_tpu.train.checkpoint import adopt_resume_seed, resolve_resume
 
 
 def batch_layout(n_data: int):
@@ -204,6 +205,7 @@ def run_distributed(config):
             f"world_size {ws} x data_parallel {dp} does not fit the "
             f"{len(jax.devices())} available devices")
     derive_runtime_fields(config, world_size=ws)
+    adopt_resume_seed(config)
     fix_seed(config.seed)
     mesh = make_mesh(n_graph=ws, n_data=dp, devices=jax.devices()[:ws * dp])
 
@@ -259,19 +261,47 @@ def run_distributed(config):
 
     total_steps = config.train.epochs * len(loader_train) // config.train.accumulation_steps
     clip = 0.3 if needs_grad_clip(config) else None
-    tx = make_optimizer(
-        config.train.learning_rate, weight_decay=config.train.weight_decay,
-        clip_norm=clip, accumulation_steps=config.train.accumulation_steps,
-        total_steps=total_steps, scheduler=str(config.train.scheduler),
-    )
+
+    def build_tx(lr_scale: float = 1.0):
+        return make_optimizer(
+            config.train.learning_rate * lr_scale,
+            weight_decay=config.train.weight_decay,
+            clip_norm=clip, accumulation_steps=config.train.accumulation_steps,
+            total_steps=total_steps, scheduler=str(config.train.scheduler),
+        )
+
+    tx = build_tx()
     state = TrainState.create(params, tx)
-    start_epoch = 0
-    if config.model.checkpoint:
+    start_epoch, start_step_in_epoch = 0, 0
+    resumed = resolve_resume(config, state)
+    if resumed is not None:
+        state, start_epoch = resumed.state, resumed.epoch
+        start_step_in_epoch = resumed.step_in_epoch
+        print(f"resume: restored {resumed.path} (epoch {start_epoch} + "
+              f"{start_step_in_epoch} step(s) applied)")
+    elif config.model.checkpoint:
         state, start_epoch, _ = restore_checkpoint(config.model.checkpoint, state)
         print(f"Checkpoint loaded from {config.model.checkpoint} (epoch {start_epoch})")
 
     is_fast = config.model.model_name.startswith("Fast")
     mmd_w = config.train.mmd.weight if is_fast else 0.0
+
+    def step_factory(lr_scale: float):
+        """(shard_mapped step, per-device step) at a scaled LR — divergence
+        recovery rolls back and retries at a decayed LR; the opt-state tree
+        is LR-independent so the rolled-back state loads unchanged. The
+        device step feeds DistributedScanRunner.with_train_step."""
+        tx2 = build_tx(lr_scale)
+        tstep, _ = make_distributed_steps(
+            model, tx2, mesh, mmd_weight=mmd_w,
+            mmd_sigma=config.train.mmd.sigma,
+            mmd_samples=config.train.mmd.samples)
+        dstep, _ = make_device_steps(
+            model, tx2, mesh, mmd_weight=mmd_w,
+            mmd_sigma=config.train.mmd.sigma,
+            mmd_samples=config.train.mmd.samples)
+        return tstep, dstep
+
     train_step, eval_step = make_distributed_steps(
         model, tx, mesh, mmd_weight=mmd_w,
         mmd_sigma=config.train.mmd.sigma, mmd_samples=config.train.mmd.samples,
@@ -303,6 +333,10 @@ def run_distributed(config):
     state, best_state, best, log_dict = train(
         state, train_step, eval_step, loader_train, loader_valid, loader_test,
         config, start_epoch=start_epoch, scan_runner=scan_runner,
+        start_step_in_epoch=start_step_in_epoch, step_factory=step_factory,
     )
-    print(f"Done. Best: {best}")
+    if best.get("preempted"):
+        print(f"Preempted (resumable). Best so far: {best}")
+    else:
+        print(f"Done. Best: {best}")
     return best

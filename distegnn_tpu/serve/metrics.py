@@ -44,6 +44,9 @@ class ServeMetrics:
         self.requests_failed = 0      # engine/model errors surfaced on futures
         self.requests_timeout = 0     # deadline passed while queued
         self.requests_rejected = 0    # bounded-queue backpressure (submit fails)
+        self.requests_retried = 0     # re-executed individually after a batch failure
+        self.requests_poison = 0      # failed even alone (the bad graph itself)
+        self.worker_restarts = 0      # dispatcher thread died and was restarted
         self.batches_executed = 0
         self.batch_slots_total = 0    # sum of padded batch capacity over batches
         self.batch_slots_filled = 0   # sum of real requests over batches
@@ -68,6 +71,18 @@ class ServeMetrics:
     def failed(self, n: int = 1) -> None:
         with self._lock:
             self.requests_failed += n
+
+    def retried(self, n: int = 1) -> None:
+        with self._lock:
+            self.requests_retried += n
+
+    def poison(self, n: int = 1) -> None:
+        with self._lock:
+            self.requests_poison += n
+
+    def worker_restarted(self, n: int = 1) -> None:
+        with self._lock:
+            self.worker_restarts += n
 
     def batch_done(self, filled: int, capacity: int,
                    latencies_ms: List[float],
@@ -112,6 +127,9 @@ class ServeMetrics:
                 "requests_failed": self.requests_failed,
                 "requests_timeout": self.requests_timeout,
                 "requests_rejected": self.requests_rejected,
+                "requests_retried": self.requests_retried,
+                "requests_poison": self.requests_poison,
+                "worker_restarts": self.worker_restarts,
                 "requests_per_sec": round(self.requests_completed / elapsed, 3),
                 "batches_executed": self.batches_executed,
                 "batch_fill_ratio": round(fill, 4),

@@ -11,7 +11,12 @@ rollout.py):
   - ingress full            -> QueueFullError raised AT SUBMIT (backpressure)
   - graph exceeds ladder    -> BucketOverflowError raised at submit
   - deadline passed queued  -> RequestTimeoutError set on the future
-  - engine/model exception  -> set on every future of the batch
+  - engine/model exception  -> each request of the batch is RETRIED ALONE
+    once (one poison graph must not take down co-batched neighbors); only
+    requests that fail solo get the exception (counted as ``poison``)
+  - dispatcher thread crash -> restarted up to ``_MAX_WORKER_RESTARTS``
+    times (pending requests survive), then every outstanding future fails
+    with the crash error and submit() raises — never a silent hang
 
 Device execution runs inline in the dispatcher thread: the accelerator is a
 serial resource, so a thread pool would only add queueing ambiguity. The
@@ -78,6 +83,11 @@ class _Request:
 
 _STOP = object()
 
+# dispatcher crash tolerance: a crashing _loop (a BUG, not an engine error —
+# those are caught per-batch) restarts this many times before the queue
+# declares itself dead and fails everything outstanding
+_MAX_WORKER_RESTARTS = 3
+
 
 class RequestQueue:
     """Bounded ingress + per-bucket micro-batcher over an InferenceEngine.
@@ -103,6 +113,7 @@ class RequestQueue:
         self._pending: Dict[Bucket, List[_Request]] = {}
         self._thread: Optional[threading.Thread] = None
         self._started = False
+        self._restarts = 0
 
     @property
     def ladder(self) -> BucketLadder:
@@ -113,7 +124,7 @@ class RequestQueue:
         if self._started:
             return self
         self._started = True
-        self._thread = threading.Thread(target=self._loop,
+        self._thread = threading.Thread(target=self._run,
                                         name="serve-dispatch", daemon=True)
         self._thread.start()
         return self
@@ -160,6 +171,30 @@ class RequestQueue:
         return self._ingress.qsize() + sum(len(v) for v in self._pending.values())
 
     # ---- dispatcher ------------------------------------------------------
+    def _run(self) -> None:
+        """Thread target: _loop with crash containment. Engine errors are
+        handled per-batch inside _execute; anything escaping _loop is a bug —
+        restart the loop (pending state survives on the instance) a bounded
+        number of times, then fail everything outstanding and mark the queue
+        dead so submit() raises instead of hanging until timeout."""
+        while True:
+            try:
+                self._loop()
+                return  # clean exit (stop/drain)
+            except Exception as exc:
+                self._restarts += 1
+                self.metrics.worker_restarted()
+                if self._restarts > _MAX_WORKER_RESTARTS:
+                    print(f"serve: dispatcher died permanently after "
+                          f"{_MAX_WORKER_RESTARTS} restarts: {exc!r}",
+                          flush=True)
+                    self._fail_all(RuntimeError(
+                        f"serve dispatcher crashed: {exc!r}"))
+                    self._started = False
+                    return
+                print(f"serve: dispatcher crashed ({exc!r}); restarting "
+                      f"({self._restarts}/{_MAX_WORKER_RESTARTS})", flush=True)
+
     def _next_flush_deadline(self) -> Optional[float]:
         ts = [rs[0].t_submit + self.batch_deadline
               for rs in self._pending.values() if rs]
@@ -233,16 +268,33 @@ class RequestQueue:
         try:
             outs = self.engine.predict_batch([r.graph for r in reqs],
                                              bucket=bucket)
-        except Exception as exc:  # surface on every future, keep serving
-            self.metrics.failed(len(reqs))
-            for r in reqs:
-                r.future.set_exception(exc)
+        except Exception:
+            # one bad graph fails the whole padded batch — retry each request
+            # ALONE once, so a poison graph only takes down itself
+            self._retry_individually(bucket, reqs)
             return
         now = time.perf_counter()
         lats = [(now - r.t_submit) * 1e3 for r in reqs]
         qms = [(t_start - r.t_submit) * 1e3 for r in reqs]
         self.metrics.batch_done(len(reqs), self.engine.max_batch, lats, qms)
         for r, out in zip(reqs, outs):
+            r.future.set_result(out)
+
+    def _retry_individually(self, bucket: Bucket, reqs: List[_Request]) -> None:
+        self.metrics.retried(len(reqs))
+        for r in reqs:
+            t_start = time.perf_counter()
+            try:
+                out = self.engine.predict_batch([r.graph], bucket=bucket)[0]
+            except Exception as solo_exc:  # fails even alone: the poison graph
+                self.metrics.poison()
+                self.metrics.failed()
+                r.future.set_exception(solo_exc)
+                continue
+            now = time.perf_counter()
+            self.metrics.batch_done(1, self.engine.max_batch,
+                                    [(now - r.t_submit) * 1e3],
+                                    [(t_start - r.t_submit) * 1e3])
             r.future.set_result(out)
 
     def _fail_all(self, exc: BaseException) -> None:

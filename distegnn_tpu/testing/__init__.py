@@ -1,0 +1,22 @@
+"""Deterministic fault injection for the resilience layer (docs/ROBUSTNESS.md).
+
+Everything here is test machinery: injectors that corrupt checkpoints, poison
+batches, and fail file opens on demand (``faults``), plus a tiny subprocess
+training entry point (``tiny_run``) the kill-and-resume tests drive.
+"""
+
+from distegnn_tpu.testing.faults import (
+    corrupt_checkpoint,
+    flaky_open,
+    inject_at_call,
+    poison_nan_batches,
+    simulate_killed_save,
+)
+
+__all__ = [
+    "corrupt_checkpoint",
+    "simulate_killed_save",
+    "poison_nan_batches",
+    "flaky_open",
+    "inject_at_call",
+]

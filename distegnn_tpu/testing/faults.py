@@ -1,0 +1,149 @@
+"""Deterministic fault injectors (docs/ROBUSTNESS.md fault-injection cookbook).
+
+Each injector reproduces ONE real failure mode at an exact, controllable
+point, so tests/test_resilience.py and tests/test_checkpoint.py can prove the
+recovery path instead of hoping for it:
+
+  - :func:`corrupt_checkpoint` — torn write / bit-rot on a checkpoint file
+    (restore must skip past it to the previous valid state);
+  - :func:`simulate_killed_save` — a save killed between tmp-write and rename
+    (the ``*.tmp`` leftover must be swept, the real file stays valid);
+  - :func:`poison_nan_batches` — one NaN batch at step N (divergence recovery
+    must roll back and retry, not kill the run);
+  - :func:`flaky_open` — transient ``OSError`` from the dataset loader
+    (bounded-backoff retry in data/loader.py must absorb it);
+  - :func:`inject_at_call` — run an arbitrary action (SIGKILL/SIGTERM to
+    self) after exactly N train-step calls.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import os
+import pickle
+from typing import Callable, Optional
+
+import numpy as np
+
+
+# ---- checkpoint faults -----------------------------------------------------
+
+def corrupt_checkpoint(path: str, mode: str = "truncate") -> None:
+    """Damage an existing checkpoint file in place.
+
+    ``truncate``: cut the file to half its size (a torn write — the manifest
+    size check catches it). ``garbage``: flip bytes in the middle keeping the
+    size (bit-rot — the CRC32 check catches it). ``headerless``: replace the
+    whole file with non-pickle bytes (no manifest entry needed to detect)."""
+    size = os.path.getsize(path)
+    if mode == "truncate":
+        with open(path, "rb+") as f:
+            f.truncate(max(1, size // 2))
+    elif mode == "garbage":
+        with open(path, "rb+") as f:
+            f.seek(size // 2)
+            chunk = f.read(64)
+            f.seek(size // 2)
+            f.write(bytes(b ^ 0xFF for b in chunk))
+    elif mode == "headerless":
+        with open(path, "wb") as f:
+            f.write(b"not a pickle at all")
+    else:
+        raise ValueError(f"unknown corruption mode {mode!r}")
+
+
+def simulate_killed_save(ckpt_dir: str, name: str = "victim.ckpt") -> str:
+    """Leave the debris of a save killed MID-WRITE: a partial ``<name>.tmp``
+    that never reached its atomic rename. Returns the tmp path. The next
+    save_checkpoint into the directory must sweep it; restore must never
+    consider it (only ``*.ckpt`` files are scanned)."""
+    os.makedirs(ckpt_dir, exist_ok=True)
+    blob = pickle.dumps({"epoch": 0, "params_leaves": [np.zeros(3)]})
+    tmp = os.path.join(ckpt_dir, name + ".tmp")
+    with open(tmp, "wb") as f:
+        f.write(blob[: len(blob) // 2])  # killed before the write finished
+    return tmp
+
+
+# ---- data faults -----------------------------------------------------------
+
+class poison_nan_batches:
+    """Loader wrapper yielding batch ``at_step`` (0-based, counted across
+    epochs) with every floating leaf replaced by NaN — the classic corrupted
+    shard / overflowed preprocessing record. Fires ``times`` times total, so
+    a rolled-back retry of the same epoch sees the CLEAN batch and recovery
+    can be proven deterministic."""
+
+    def __init__(self, loader, at_step: int, times: int = 1):
+        self.loader = loader
+        self.at_step = int(at_step)
+        self.times = int(times)
+        self._count = 0
+        self.fired = 0
+
+    def set_epoch(self, epoch: int) -> None:
+        self.loader.set_epoch(epoch)
+
+    def __len__(self) -> int:
+        return len(self.loader)
+
+    @staticmethod
+    def _nanify(x):
+        arr = np.asarray(x)
+        if np.issubdtype(arr.dtype, np.floating):
+            return np.full_like(arr, np.nan)
+        return x
+
+    def __iter__(self):
+        import jax
+
+        for batch in self.loader:
+            if self._count == self.at_step and self.fired < self.times:
+                self.fired += 1
+                batch = jax.tree.map(self._nanify, batch)
+            self._count += 1
+            yield batch
+
+
+@contextlib.contextmanager
+def flaky_open(fail_times: int, exc: Optional[OSError] = None):
+    """Patch the data loader's open hook so the first ``fail_times`` opens
+    raise a transient ``OSError`` (default: errno 5, the NFS/GCS hiccup
+    shape), then defer to the real ``open``. Context manager; restores the
+    hook on exit. Yields a dict with the observed call count."""
+    from distegnn_tpu.data import loader as loader_mod
+
+    err = exc if exc is not None else OSError(5, "injected transient I/O error")
+    calls = {"n": 0}
+    real = loader_mod._file_open
+
+    def _open(path, mode="rb"):
+        calls["n"] += 1
+        if calls["n"] <= fail_times:
+            raise type(err)(*err.args)
+        return real(path, mode)
+
+    loader_mod._file_open = _open
+    try:
+        yield calls
+    finally:
+        loader_mod._file_open = real
+
+
+# ---- process faults --------------------------------------------------------
+
+def inject_at_call(step: Callable, n: int, action: Callable[[], None]) -> Callable:
+    """Wrap a train step so ``action()`` runs immediately AFTER the ``n``-th
+    call (1-based) returns — e.g. ``lambda: os.kill(os.getpid(),
+    signal.SIGKILL)`` for an abrupt preemption, or ``signal.raise_signal``
+    for a graceful one. The wrapped step is otherwise transparent."""
+    count = {"i": 0}
+
+    def wrapped(state, batch, key):
+        out = step(state, batch, key)
+        count["i"] += 1
+        if count["i"] == n:
+            action()
+        return out
+
+    return wrapped

@@ -1,6 +1,14 @@
 """Training runtime (L5): jitted step, losses, checkpointing, outer loop."""
 
-from distegnn_tpu.train.checkpoint import restore_checkpoint, save_checkpoint
+from distegnn_tpu.train.checkpoint import (
+    CheckpointCorruptError,
+    RestoredRun,
+    find_resume_checkpoint,
+    restore_checkpoint,
+    restore_for_resume,
+    save_checkpoint,
+    verify_checkpoint,
+)
 from distegnn_tpu.train.loss import (
     masked_mse,
     mmd_loss,
@@ -30,6 +38,11 @@ __all__ = [
     "weighted_local_loss",
     "save_checkpoint",
     "restore_checkpoint",
+    "restore_for_resume",
+    "find_resume_checkpoint",
+    "verify_checkpoint",
+    "CheckpointCorruptError",
+    "RestoredRun",
     "train",
     "run_epoch_train",
     "run_epoch_eval",

@@ -1,4 +1,4 @@
-"""Checkpoint save/restore (reference utils/train.py:234-259, main.py:208-220).
+"""Durable checkpoint save/restore (reference utils/train.py:234-259, main.py:208-220).
 
 Saves {epoch, params, opt_state, losses, config} — the same payload as the
 reference's best_model.pth/last_model.pth. Written by process 0 only
@@ -10,16 +10,74 @@ restore time (so saved files don't depend on optax's internal tree classes
 being pickleable across versions). Unlike the reference (whose DDP-wrapped
 state_dicts are not portable between world sizes, SURVEY.md §5.4), params here
 carry no wrapper prefix — checkpoints are world-size-portable by construction.
+
+Durability layer (docs/ROBUSTNESS.md):
+  - every save is tmp-write + fsync + atomic rename, and records a CRC32 +
+    size entry in a per-directory ``manifest.json`` (itself written
+    atomically), so restore can prove a file intact before unpickling it;
+  - truncated/corrupt files surface as a typed :class:`CheckpointCorruptError`
+    naming the path, never a bare ``EOFError``/``UnpicklingError``;
+  - ``save_checkpoint`` sweeps ``*.tmp`` leftovers of a previously killed
+    write out of the directory before writing;
+  - step-granular checkpoints (``step_<n>.ckpt``) rotate, keeping the last K
+    alongside ``best_model.ckpt``/``last_model.ckpt``/``preempt_model.ckpt``;
+  - ``find_resume_checkpoint`` scans a whole log dir, verifies checksums, and
+    falls back past corrupt/incompatible files to the newest valid state —
+    the ``train.resume: auto`` entry point.
 """
 
 from __future__ import annotations
 
+import glob
+import json
 import os
 import pickle
-from typing import Any, Optional
+import re
+import zlib
+from dataclasses import dataclass, field
+from typing import Any, List, Optional
 
 import jax
 import numpy as np
+
+MANIFEST_NAME = "manifest.json"
+PREEMPT_MARKER = "PREEMPTED"
+
+# payload keys every intact checkpoint must carry (older checkpoints predate
+# step_in_epoch/seed — those stay optional for back-compat)
+_REQUIRED_KEYS = ("epoch", "params_leaves", "opt_state_leaves", "step")
+
+# unpickle failure modes of a torn/garbled file — anything else (e.g. a
+# genuine OSError opening the file) propagates untouched
+_UNPICKLE_ERRORS = (EOFError, pickle.UnpicklingError, AttributeError,
+                    ImportError, IndexError, MemoryError, TypeError,
+                    ValueError)
+
+
+class CheckpointCorruptError(RuntimeError):
+    """A checkpoint file failed verification (CRC/size mismatch against its
+    manifest entry, truncated pickle, or missing payload keys)."""
+
+    def __init__(self, path: str, reason: str):
+        super().__init__(f"corrupt checkpoint {path}: {reason}")
+        self.path = path
+        self.reason = reason
+
+
+@dataclass
+class RestoredRun:
+    """Everything a resumed run needs to replay the schedule exactly: the
+    train state, how many epochs completed, how many steps of the NEXT epoch
+    already applied (mid-epoch cadence/preempt saves), and the seed the run
+    was started with (PRNG keys derive from (seed, epoch, step), so carrying
+    the seed lets resume detect a mismatched --seed override)."""
+
+    state: Any
+    epoch: int
+    step_in_epoch: int = 0
+    losses: dict = field(default_factory=dict)
+    seed: Optional[int] = None
+    path: Optional[str] = None
 
 
 def _to_leaves(tree) -> list:
@@ -45,8 +103,55 @@ def _from_leaves(template, leaves: list):
     return jax.tree.unflatten(treedef, leaves)
 
 
+# ---- manifest --------------------------------------------------------------
+
+def _manifest_path(ckpt_dir: str) -> str:
+    return os.path.join(ckpt_dir, MANIFEST_NAME)
+
+
+def read_manifest(ckpt_dir: str) -> dict:
+    """{basename: {crc32, size, epoch, step, step_in_epoch, time}} — empty on
+    a missing or unparseable manifest (the manifest is an integrity aid, not
+    a dependency: restore still works without it)."""
+    try:
+        with open(_manifest_path(ckpt_dir)) as f:
+            m = json.load(f)
+        return m if isinstance(m, dict) else {}
+    except (OSError, json.JSONDecodeError):
+        return {}
+
+
+def _write_manifest(ckpt_dir: str, manifest: dict) -> None:
+    tmp = _manifest_path(ckpt_dir) + ".manifest.tmp"
+    with open(tmp, "w") as f:
+        json.dump(manifest, f, indent=2, sort_keys=True)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, _manifest_path(ckpt_dir))
+
+
+def _sweep_stale_tmps(ckpt_dir: str) -> None:
+    """Remove ``*.tmp`` leftovers of a previous killed write. Safe by
+    construction: a live save holds no .tmp across calls (tmp → rename is one
+    call), and process 0 is the only writer."""
+    for stale in glob.glob(os.path.join(ckpt_dir, "*.tmp")):
+        try:
+            os.remove(stale)
+            print(f"checkpoint: removed stale partial write {stale}", flush=True)
+        except OSError:
+            pass
+
+
+# ---- save ------------------------------------------------------------------
+
 def save_checkpoint(path: str, state, epoch: int, losses: Optional[dict] = None,
-                    config: Optional[dict] = None) -> None:
+                    config: Optional[dict] = None, seed: Optional[int] = None,
+                    step_in_epoch: int = 0) -> None:
+    """Atomically write one checkpoint + its CRC manifest entry.
+
+    ``epoch`` counts COMPLETED epochs; ``step_in_epoch`` counts steps of
+    epoch ``epoch + 1`` already applied to ``state`` (0 = epoch boundary) —
+    a resumed run replays the schedule from exactly there."""
     if jax.process_index() != 0:
         return
     payload = {
@@ -54,14 +159,95 @@ def save_checkpoint(path: str, state, epoch: int, losses: Optional[dict] = None,
         "params_leaves": _to_leaves(state.params),
         "opt_state_leaves": _to_leaves(state.opt_state),
         "step": int(state.step),
+        "step_in_epoch": int(step_in_epoch),
+        "seed": None if seed is None else int(seed),
         "losses": losses or {},
         "config": config,
     }
+    ckpt_dir = os.path.dirname(path) or "."
+    os.makedirs(ckpt_dir, exist_ok=True)
+    _sweep_stale_tmps(ckpt_dir)
+    blob = pickle.dumps(payload)
     tmp = path + ".tmp"
-    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
     with open(tmp, "wb") as f:
-        pickle.dump(payload, f)
+        f.write(blob)
+        f.flush()
+        os.fsync(f.fileno())
     os.replace(tmp, path)  # atomic: a crash never leaves a torn checkpoint
+    import time as _time
+
+    manifest = read_manifest(ckpt_dir)
+    manifest[os.path.basename(path)] = {
+        "crc32": zlib.crc32(blob) & 0xFFFFFFFF,
+        "size": len(blob),
+        "epoch": int(epoch),
+        "step": int(state.step),
+        "step_in_epoch": int(step_in_epoch),
+        "time": _time.time(),
+    }
+    # drop entries whose files are gone (rotation, manual cleanup)
+    manifest = {k: v for k, v in manifest.items()
+                if os.path.exists(os.path.join(ckpt_dir, k))}
+    _write_manifest(ckpt_dir, manifest)
+
+
+_STEP_RE = re.compile(r"^step_(\d+)\.ckpt$")
+
+
+def step_checkpoint_name(step: int) -> str:
+    return f"step_{int(step):010d}.ckpt"
+
+
+def rotate_checkpoints(ckpt_dir: str, keep: int) -> List[str]:
+    """Keep the newest ``keep`` step-granular checkpoints (by step number);
+    ``best_model``/``last_model``/``preempt_model`` never rotate. Returns the
+    removed paths. Manifest entries for removed files are dropped on the next
+    save (see save_checkpoint's existence filter)."""
+    if jax.process_index() != 0:
+        return []
+    steps = []
+    for p in glob.glob(os.path.join(ckpt_dir, "step_*.ckpt")):
+        m = _STEP_RE.match(os.path.basename(p))
+        if m:
+            steps.append((int(m.group(1)), p))
+    steps.sort()
+    removed = []
+    for _, p in steps[:max(0, len(steps) - max(int(keep), 1))]:
+        try:
+            os.remove(p)
+            removed.append(p)
+        except OSError:
+            pass
+    return removed
+
+
+# ---- verify + restore ------------------------------------------------------
+
+def verify_checkpoint(path: str) -> dict:
+    """Read + integrity-check one checkpoint file; returns the payload.
+    Raises CheckpointCorruptError on CRC/size mismatch vs the directory
+    manifest, torn pickle, or missing payload keys."""
+    try:
+        with open(path, "rb") as f:
+            blob = f.read()
+    except FileNotFoundError:
+        raise CheckpointCorruptError(path, "file missing") from None
+    entry = read_manifest(os.path.dirname(path) or ".").get(os.path.basename(path))
+    if entry is not None:
+        if len(blob) != int(entry.get("size", -1)):
+            raise CheckpointCorruptError(
+                path, f"size {len(blob)} != manifest {entry.get('size')} "
+                      "(truncated or partially-written file)")
+        if (zlib.crc32(blob) & 0xFFFFFFFF) != int(entry.get("crc32", -1)):
+            raise CheckpointCorruptError(
+                path, "CRC32 mismatch vs manifest (bit-rot or torn write)")
+    try:
+        payload = pickle.loads(blob)
+    except _UNPICKLE_ERRORS as e:
+        raise CheckpointCorruptError(path, f"unpickle failed: {e!r}") from None
+    if not isinstance(payload, dict) or any(k not in payload for k in _REQUIRED_KEYS):
+        raise CheckpointCorruptError(path, "payload missing required keys")
+    return payload
 
 
 def _with_config_hint(payload, e: ValueError) -> ValueError:
@@ -72,14 +258,13 @@ def _with_config_hint(payload, e: ValueError) -> ValueError:
     return ValueError(f"{e}{hint}")
 
 
-def restore_checkpoint(path: str, state) -> tuple[Any, int, dict]:
-    """Restore into the structure of ``state`` (a freshly-created TrainState).
-    Returns (state, start_epoch, losses). The optimizer configuration must
-    match the one the checkpoint was written with (grad-accumulation wrapping
-    changes the opt-state tree); evaluation-only consumers should use
-    :func:`restore_params` instead."""
-    with open(path, "rb") as f:
-        payload = pickle.load(f)
+def restore_for_resume(path: str, state) -> RestoredRun:
+    """Verified restore into the structure of ``state`` (a freshly-created
+    TrainState), carrying the resume coordinates (epoch, step_in_epoch, seed).
+    The optimizer configuration must match the one the checkpoint was written
+    with (grad-accumulation wrapping changes the opt-state tree);
+    evaluation-only consumers should use :func:`restore_params` instead."""
+    payload = verify_checkpoint(path)
     from distegnn_tpu.train.step import TrainState
 
     try:
@@ -90,16 +275,134 @@ def restore_checkpoint(path: str, state) -> tuple[Any, int, dict]:
         )
     except ValueError as e:
         raise _with_config_hint(payload, e) from None
-    return restored, payload["epoch"], payload.get("losses", {})
+    return RestoredRun(
+        state=restored,
+        epoch=int(payload["epoch"]),
+        step_in_epoch=int(payload.get("step_in_epoch", 0) or 0),
+        losses=payload.get("losses", {}) or {},
+        seed=payload.get("seed"),
+        path=path,
+    )
+
+
+def restore_checkpoint(path: str, state) -> tuple[Any, int, dict]:
+    """Back-compat wrapper over :func:`restore_for_resume`: returns
+    (state, start_epoch, losses)."""
+    r = restore_for_resume(path, state)
+    return r.state, r.epoch, r.losses
 
 
 def restore_params(path: str, params) -> Any:
     """Params-only restore for evaluation/rollout: ignores the saved
     optimizer state, so a checkpoint written with ANY optimizer wrapping
     (grad accumulation, schedules) loads into a bare model."""
-    with open(path, "rb") as f:
-        payload = pickle.load(f)
+    payload = verify_checkpoint(path)
     try:
         return _from_leaves(params, payload["params_leaves"])
     except ValueError as e:
         raise _with_config_hint(payload, e) from None
+
+
+# ---- auto-resume scan ------------------------------------------------------
+
+def scan_resume_candidates(log_dir: str) -> List[str]:
+    """All checkpoints under ``<log_dir>/<exp>/state_dict/`` (and a bare
+    ``<log_dir>/state_dict/``), newest first by mtime — exp dirs are
+    timestamped per run, so a preemption's ``preempt_model.ckpt`` (written at
+    death) naturally sorts first."""
+    pats = [os.path.join(log_dir, "*", "state_dict", "*.ckpt"),
+            os.path.join(log_dir, "state_dict", "*.ckpt")]
+    hits = [p for pat in pats for p in glob.glob(pat)]
+    return sorted(hits, key=lambda p: os.path.getmtime(p), reverse=True)
+
+
+def peek_resume_seed(log_dir: str):
+    """(seed, path) of the newest checksum-valid checkpoint under ``log_dir``,
+    or (None, None). Called BEFORE the model/loaders exist — a resumed run
+    must adopt the original run's seed before anything derives from it (loader
+    permutations, PRNG folds), and the full architecture-checked restore can
+    only happen once a template TrainState exists."""
+    for path in scan_resume_candidates(log_dir):
+        try:
+            payload = verify_checkpoint(path)
+        except CheckpointCorruptError:
+            continue
+        return payload.get("seed"), path
+    return None, None
+
+
+def find_resume_checkpoint(log_dir: str, state) -> Optional[RestoredRun]:
+    """``train.resume: auto``: scan the experiment log dir, verify checksums,
+    and restore the NEWEST valid checkpoint — falling back past corrupt /
+    truncated / architecture-incompatible files with a printed diagnosis.
+    Returns None when nothing under ``log_dir`` restores (fresh start)."""
+    for path in scan_resume_candidates(log_dir):
+        try:
+            return restore_for_resume(path, state)
+        except CheckpointCorruptError as e:
+            print(f"resume: skipping {path} ({e.reason})", flush=True)
+        except ValueError as e:
+            print(f"resume: skipping incompatible {path} ({e})", flush=True)
+    return None
+
+
+def adopt_resume_seed(config) -> None:
+    """With ``train.resume`` set, adopt the seed of the checkpoint we are
+    about to resume BEFORE anything derives from ``config.seed`` (loader
+    permutations and per-step PRNG keys fold (seed, epoch, step) — replaying
+    the schedule exactly requires the original seed, not a drifted default)."""
+    resume = config.train.get("resume")
+    if not resume:
+        return
+    if resume == "auto":
+        seed, path = peek_resume_seed(config.log.log_dir)
+    else:
+        try:
+            seed, path = verify_checkpoint(resume).get("seed"), resume
+        except CheckpointCorruptError:
+            return  # resolve_resume raises the loud, typed error
+    if seed is not None and int(seed) != int(config.seed):
+        print(f"resume: adopting seed {seed} from {path} (config had "
+              f"{config.seed}) so the resumed run replays the schedule",
+              flush=True)
+        config.seed = int(seed)
+
+
+def resolve_resume(config, state) -> Optional[RestoredRun]:
+    """The ``train.resume`` entry point (main.py / parallel/launch.py):
+    'auto' scans ``log.log_dir`` and falls back past corrupt files; an
+    explicit path fails loudly. Returns a RestoredRun or None (fresh start)."""
+    resume = config.train.get("resume")
+    if not resume:
+        return None
+    if resume == "auto":
+        rr = find_resume_checkpoint(config.log.log_dir, state)
+        if rr is None:
+            print("resume: auto found no valid checkpoint under "
+                  f"{config.log.log_dir}; starting fresh", flush=True)
+        return rr
+    return restore_for_resume(resume, state)
+
+
+def write_preempt_marker(ckpt_dir: str, ckpt_name: str, epoch: int,
+                         step_in_epoch: int) -> None:
+    """Drop the resumable marker scripts key off (lib_resume_paused.sh
+    newest_resumable_ckpt / convergence_session.sh): the run exited on
+    purpose mid-training and the named checkpoint continues it."""
+    if jax.process_index() != 0:
+        return
+    import time as _time
+
+    tmp = os.path.join(ckpt_dir, PREEMPT_MARKER + ".tmp")
+    with open(tmp, "w") as f:
+        json.dump({"checkpoint": ckpt_name, "epoch": int(epoch),
+                   "step_in_epoch": int(step_in_epoch),
+                   "time": _time.time()}, f)
+    os.replace(tmp, os.path.join(ckpt_dir, PREEMPT_MARKER))
+
+
+def clear_preempt_marker(ckpt_dir: str) -> None:
+    try:
+        os.remove(os.path.join(ckpt_dir, PREEMPT_MARKER))
+    except OSError:
+        pass

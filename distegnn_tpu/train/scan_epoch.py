@@ -93,6 +93,11 @@ class ScanEpochRunner:
                 if ld is not None:
                     self.eval_sets[name] = (stack_dataset(ld), len(ld), ld.batch_size)
 
+        self._compile(train_step, eval_step)
+
+    def _compile(self, train_step: Callable, eval_step: Optional[Callable]):
+        self._train_step, self._eval_step = train_step, eval_step
+
         def pick(data: GraphBatch, idx):
             return jax.tree.map(lambda a: a[idx], data)
 
@@ -117,6 +122,16 @@ class ScanEpochRunner:
 
         self._run_train = jax.jit(run_train)
         self._run_eval = jax.jit(run_eval) if eval_step is not None else None
+
+    def with_train_step(self, train_step: Callable) -> "ScanEpochRunner":
+        """A copy sharing the device-resident datasets but scanning a NEW
+        train step — divergence recovery swaps in a decayed-LR step without
+        re-staging HBM (trainer.py rollback path)."""
+        import copy
+
+        new = copy.copy(self)
+        new._compile(train_step, self._eval_step)
+        return new
 
     def _perm(self, loader: GraphLoader, epoch: int, steps: int, bsz: int):
         loader.set_epoch(epoch)
@@ -246,8 +261,6 @@ class DistributedScanRunner:
                  loader_train: ShardedGraphLoader, seed: int,
                  loader_valid: Optional[ShardedGraphLoader] = None,
                  loader_test: Optional[ShardedGraphLoader] = None):
-        from jax.sharding import PartitionSpec as P
-
         self.seed = seed
         self.loader = loader_train
         self.dp = loader_train.data_parallel
@@ -261,7 +274,16 @@ class DistributedScanRunner:
                 if ld is not None:
                     self.eval_sets[name] = (stack_sharded_dataset(ld, mesh),
                                             len(ld), ld.loaders[0].batch_size)
+        self._mesh = mesh
+        self._compile(device_train_step, device_eval_step)
 
+    def _compile(self, device_train_step: Callable,
+                 device_eval_step: Optional[Callable]):
+        from jax.sharding import PartitionSpec as P
+
+        self._device_train_step = device_train_step
+        self._device_eval_step = device_eval_step
+        mesh = self._mesh
         dp = self.dp
         data_spec = P(GRAPH_AXIS)
         # [S, B] replicated, or [S, D, B] with the D axis sharded over DATA:
@@ -305,6 +327,16 @@ class DistributedScanRunner:
                 run_eval, mesh=mesh,
                 in_specs=(P(), data_spec, perm_spec),
                 out_specs=P(), check_vma=False))
+
+    def with_train_step(self, device_train_step: Callable) -> "DistributedScanRunner":
+        """A copy sharing the device-resident sharded datasets but scanning a
+        NEW per-device train step — divergence recovery swaps in a decayed-LR
+        step without re-staging HBM (trainer.py rollback path)."""
+        import copy
+
+        new = copy.copy(self)
+        new._compile(device_train_step, self._device_eval_step)
+        return new
 
     def _perm_array(self, order: np.ndarray, steps: int, draw: int):
         o = np.asarray(order[: steps * draw], dtype=np.int32)

@@ -13,6 +13,7 @@ need only `jax.distributed.initialize()` before the same code.
 from __future__ import annotations
 
 import os
+import sys
 
 import jax
 import numpy as np
@@ -29,7 +30,12 @@ from distegnn_tpu.train import (
     restore_checkpoint,
     train,
 )
+from distegnn_tpu.train.checkpoint import adopt_resume_seed, resolve_resume
 from distegnn_tpu.utils.seed import fix_seed
+
+# exit code of a preempted-but-resumable run (BSD EX_TEMPFAIL); session
+# scripts (lib_resume_paused.sh) key retry-with-resume off it
+EXIT_PREEMPTED = 75
 
 
 def count_parameters(params) -> int:
@@ -114,6 +120,7 @@ def main(argv=None):
     if ws not in (None, 1):
         raise ValueError(f"accelerate_mode=cutoff_edges is single-device; got --world_size {ws}")
     derive_runtime_fields(config, world_size=1)
+    adopt_resume_seed(config)
     fix_seed(config.seed)
 
     # Data
@@ -141,27 +148,45 @@ def main(argv=None):
 
     # Optimizer (+ reference clip rule and cosine schedule option)
     total_steps = config.train.epochs * len(loader_train) // config.train.accumulation_steps
-    tx = make_optimizer(
-        config.train.learning_rate,
-        weight_decay=config.train.weight_decay,
-        clip_norm=0.3 if needs_grad_clip(config) else None,
-        accumulation_steps=config.train.accumulation_steps,
-        total_steps=total_steps,
-        scheduler=str(config.train.scheduler),
-    )
-    state = TrainState.create(params, tx)
 
-    start_epoch = 0
-    if config.model.checkpoint:
-        state, start_epoch, _ = restore_checkpoint(config.model.checkpoint, state)
-        print(f"Checkpoint loaded from {config.model.checkpoint} (epoch {start_epoch})")
+    def build_tx(lr_scale: float = 1.0):
+        return make_optimizer(
+            config.train.learning_rate * lr_scale,
+            weight_decay=config.train.weight_decay,
+            clip_norm=0.3 if needs_grad_clip(config) else None,
+            accumulation_steps=config.train.accumulation_steps,
+            total_steps=total_steps,
+            scheduler=str(config.train.scheduler),
+        )
+
+    tx = build_tx()
+    state = TrainState.create(params, tx)
 
     # MMD applies to Fast* (virtual-node) models only (utils/train.py:119)
     is_fast = config.model.model_name.startswith("Fast")
     mmd_w = config.train.mmd.weight if is_fast else 0.0
-    train_step = jax.jit(make_train_step(model, tx, mmd_weight=mmd_w,
-                                         mmd_sigma=config.train.mmd.sigma,
-                                         mmd_samples=config.train.mmd.samples))
+
+    def step_factory(lr_scale: float):
+        """Jitted train step at a scaled LR — divergence recovery swaps it in
+        after rolling back to the last finite state (the opt-state TREE is
+        LR-independent, so the rolled-back state loads unchanged)."""
+        return jax.jit(make_train_step(model, build_tx(lr_scale),
+                                       mmd_weight=mmd_w,
+                                       mmd_sigma=config.train.mmd.sigma,
+                                       mmd_samples=config.train.mmd.samples))
+
+    start_epoch, start_step_in_epoch = 0, 0
+    resumed = resolve_resume(config, state)
+    if resumed is not None:
+        state, start_epoch = resumed.state, resumed.epoch
+        start_step_in_epoch = resumed.step_in_epoch
+        print(f"resume: restored {resumed.path} (epoch {start_epoch} + "
+              f"{start_step_in_epoch} step(s) applied)")
+    elif config.model.checkpoint:
+        state, start_epoch, _ = restore_checkpoint(config.model.checkpoint, state)
+        print(f"Checkpoint loaded from {config.model.checkpoint} (epoch {start_epoch})")
+
+    train_step = step_factory(1.0)
     eval_step = jax.jit(make_eval_step(model))
 
     # scan_epochs: fold the epoch loop into one on-device lax.scan program
@@ -184,10 +209,16 @@ def main(argv=None):
     state, best_state, best, log_dict = train(
         state, train_step, eval_step, loader_train, loader_valid, loader_test,
         config, start_epoch=start_epoch, scan_runner=scan_runner,
+        start_step_in_epoch=start_step_in_epoch, step_factory=step_factory,
     )
-    print(f"Done. Best: {best}")
+    if best.get("preempted"):
+        print(f"Preempted (resumable). Best so far: {best}")
+    else:
+        print(f"Done. Best: {best}")
     return best
 
 
 if __name__ == "__main__":
-    main()
+    _best = main()
+    if isinstance(_best, dict) and _best.get("preempted"):
+        sys.exit(EXIT_PREEMPTED)

@@ -76,16 +76,30 @@ EOF
 
 CKPT_ARGS=()
 RUN_TRAINING=1
-LAST=$(ls -dt logs/nbody/*/state_dict/last_model.ckpt 2>/dev/null | head -1 || true)
+. scripts/lib_resume_paused.sh   # newest_resumable_ckpt
+LAST=$(newest_resumable_ckpt logs/nbody || true)
 if [ -n "$LAST" ]; then
-  PREV_EXP=$(dirname "$(dirname "$LAST")")
-  if [ -f "$PREV_EXP/log/log.json" ] && run_finished "$LAST" "$PREV_EXP/log/log.json" "$EPOCHS"; then
-    echo "previous run $PREV_EXP already finished — capturing artifacts only"
-    RUN_TRAINING=0
-  else
-    echo "resuming from $LAST"
-    CKPT_ARGS=(--checkpoint "$LAST")
-  fi
+  case "$LAST" in
+    */preempt_model.ckpt|*/step_*.ckpt)
+      # Preempted (SIGTERM handler) or mid-epoch cadence save: by
+      # construction the run died mid-training (a finished run's newest
+      # checkpoint is always its last_model), so skip the run_finished
+      # probe and restore the full (epoch, step, optimizer, RNG seed)
+      # coordinates through the trainer's resume path.
+      echo "resuming preempted/mid-epoch checkpoint $LAST"
+      CKPT_ARGS=(--resume "$LAST")
+      ;;
+    *)
+      PREV_EXP=$(dirname "$(dirname "$LAST")")
+      if [ -f "$PREV_EXP/log/log.json" ] && run_finished "$LAST" "$PREV_EXP/log/log.json" "$EPOCHS"; then
+        echo "previous run $PREV_EXP already finished — capturing artifacts only"
+        RUN_TRAINING=0
+      else
+        echo "resuming from $LAST"
+        CKPT_ARGS=(--resume "$LAST")
+      fi
+      ;;
+  esac
 fi
 
 if [ "$RUN_TRAINING" -eq 1 ]; then
@@ -152,6 +166,7 @@ def stage_key(cfg):
     import copy
     c = copy.deepcopy(cfg)
     c.get("train", {}).pop("epochs", None)
+    c.get("train", {}).pop("resume", None)
     c.get("model", {}).pop("checkpoint", None)
     c.get("log", {}).pop("exp_name", None)
     return json.dumps(c, sort_keys=True)

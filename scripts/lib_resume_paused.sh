@@ -15,6 +15,20 @@
 # agent-driver's cmdline embeds 'bench.py' and matching it is the
 # session-freezing hazard (BASELINE.md).
 
+newest_resumable_ckpt() {  # newest_resumable_ckpt <log_root>  -> path; rc 1 if none
+  # Newest-by-mtime across the trainer's three resumable checkpoint kinds
+  # (docs/ROBUSTNESS.md): preempt_model.ckpt (SIGTERM handler; its dir also
+  # carries a PREEMPTED marker), mid-epoch step_*.ckpt cadence saves, and the
+  # eval-epoch last_model.ckpt. mtime ordering makes stale preempt markers
+  # harmless — a later finished/resumed run's checkpoints sort first.
+  local root=${1:?usage: newest_resumable_ckpt <log_root>} best
+  best=$(ls -t "$root"/*/state_dict/preempt_model.ckpt \
+               "$root"/*/state_dict/step_*.ckpt \
+               "$root"/*/state_dict/last_model.ckpt 2>/dev/null | head -1)
+  [ -n "$best" ] || return 1
+  printf '%s\n' "$best"
+}
+
 bench_py_live() {
   local p
   for p in /proc/[0-9]*; do

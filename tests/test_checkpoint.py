@@ -1,0 +1,169 @@
+"""Durable-checkpoint unit contract (train/checkpoint.py, docs/ROBUSTNESS.md):
+atomic writes that survive a kill mid-write, CRC-manifested verification with
+typed corruption errors, rotation bounds, fallback past corrupt files, and
+manifest-free portability of a moved checkpoint."""
+
+from __future__ import annotations
+
+import glob
+import json
+import os
+
+import numpy as np
+import pytest
+
+from distegnn_tpu.testing.faults import corrupt_checkpoint, simulate_killed_save
+from distegnn_tpu.train.checkpoint import (
+    MANIFEST_NAME, PREEMPT_MARKER, CheckpointCorruptError, clear_preempt_marker,
+    find_resume_checkpoint, read_manifest, restore_for_resume, restore_params,
+    rotate_checkpoints, save_checkpoint, step_checkpoint_name,
+    verify_checkpoint, write_preempt_marker)
+from distegnn_tpu.train.step import TrainState, make_optimizer
+
+
+def _state(scale=1.0):
+    params = {"w": np.full((3, 2), scale, np.float32),
+              "b": np.full((2,), scale * 0.5, np.float32)}
+    return TrainState.create(params, make_optimizer(1e-3))
+
+
+def _leaves_equal(a, b):
+    import jax
+
+    for x, y in zip(jax.tree.leaves(a), jax.tree.leaves(b)):
+        np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
+
+
+# ---------------------------------------------------------------- atomicity
+
+def test_save_sweeps_debris_of_killed_write(tmp_path):
+    """A save killed between tmp-write and rename leaves only a *.tmp; the
+    next save must sweep it, and restore must never consider it."""
+    d = str(tmp_path)
+    debris = simulate_killed_save(d, name="victim.ckpt")
+    assert os.path.exists(debris)
+    assert not os.path.exists(os.path.join(d, "victim.ckpt"))  # rename never ran
+
+    path = os.path.join(d, "last_model.ckpt")
+    save_checkpoint(path, _state(), epoch=3, seed=11)
+    assert glob.glob(os.path.join(d, "*.tmp")) == []           # debris swept
+    payload = verify_checkpoint(path)                          # intact + in manifest
+    assert payload["epoch"] == 3 and payload["seed"] == 11
+    entry = read_manifest(d)[os.path.basename(path)]
+    assert entry["size"] > 0 and "crc32" in entry
+
+
+def test_restore_roundtrips_state_and_coordinates(tmp_path):
+    path = str(tmp_path / "last_model.ckpt")
+    st = _state(scale=2.5)
+    save_checkpoint(path, st, epoch=7, seed=5, step_in_epoch=3,
+                    losses={"best_mse": 0.25})
+    r = restore_for_resume(path, _state())    # fresh template, same structure
+    assert (r.epoch, r.step_in_epoch, r.seed) == (7, 3, 5)
+    assert r.losses["best_mse"] == 0.25
+    _leaves_equal(r.state.params, st.params)
+    _leaves_equal(r.state.opt_state, st.opt_state)
+
+
+# ---------------------------------------------------------------- corruption
+
+@pytest.mark.parametrize("mode", ["truncate", "garbage", "headerless"])
+def test_corruption_raises_typed_error(tmp_path, mode):
+    path = str(tmp_path / "last_model.ckpt")
+    save_checkpoint(path, _state(), epoch=1)
+    corrupt_checkpoint(path, mode=mode)
+    with pytest.raises(CheckpointCorruptError) as ei:
+        verify_checkpoint(path)
+    assert ei.value.path == path and ei.value.reason
+
+
+def test_truncation_detected_even_without_manifest(tmp_path):
+    """The manifest is an aid, not a dependency: with it deleted, a torn
+    pickle still surfaces as the typed error (unpickle layer)."""
+    path = str(tmp_path / "last_model.ckpt")
+    save_checkpoint(path, _state(), epoch=1)
+    os.remove(str(tmp_path / MANIFEST_NAME))
+    corrupt_checkpoint(path, mode="truncate")
+    with pytest.raises(CheckpointCorruptError):
+        verify_checkpoint(path)
+
+
+def test_resume_falls_back_past_corrupt_newest(tmp_path, capsys):
+    d = tmp_path / "exp" / "state_dict"
+    older = str(d / step_checkpoint_name(4))
+    newer = str(d / step_checkpoint_name(8))
+    save_checkpoint(older, _state(scale=1.0), epoch=1, step_in_epoch=0)
+    save_checkpoint(newer, _state(scale=9.0), epoch=2, step_in_epoch=0)
+    os.utime(older, (1, 1))                   # force mtime order
+    corrupt_checkpoint(newer, mode="garbage")
+
+    r = find_resume_checkpoint(str(tmp_path), _state())
+    assert r is not None and r.path == older and r.epoch == 1
+    assert "resume: skipping" in capsys.readouterr().out
+
+    corrupt_checkpoint(older, mode="truncate")
+    assert find_resume_checkpoint(str(tmp_path), _state()) is None
+
+
+# ---------------------------------------------------------------- rotation
+
+def test_rotation_keeps_last_k_steps_and_all_named_checkpoints(tmp_path):
+    d = str(tmp_path)
+    for name in ("best_model.ckpt", "last_model.ckpt", "preempt_model.ckpt"):
+        save_checkpoint(os.path.join(d, name), _state(), epoch=0)
+    for step in range(1, 7):
+        save_checkpoint(os.path.join(d, step_checkpoint_name(step)),
+                        _state(), epoch=0, step_in_epoch=step)
+        rotate_checkpoints(d, keep=3)
+    steps = sorted(os.path.basename(p)
+                   for p in glob.glob(os.path.join(d, "step_*.ckpt")))
+    assert steps == [step_checkpoint_name(s) for s in (4, 5, 6)]
+    for name in ("best_model.ckpt", "last_model.ckpt", "preempt_model.ckpt"):
+        assert os.path.exists(os.path.join(d, name))   # never rotate
+    # the next save drops manifest entries of rotated-away files
+    save_checkpoint(os.path.join(d, "last_model.ckpt"), _state(), epoch=1)
+    manifest = read_manifest(d)
+    assert step_checkpoint_name(1) not in manifest
+    assert step_checkpoint_name(6) in manifest
+
+
+# ---------------------------------------------------------------- portability
+
+def test_checkpoint_portable_when_moved_without_manifest(tmp_path):
+    """A checkpoint copied out of its directory (no manifest alongside)
+    restores anywhere — durability metadata never became a load dependency,
+    and params leaves carry no world-size/wrapper prefix."""
+    src = str(tmp_path / "a" / "last_model.ckpt")
+    st = _state(scale=3.0)
+    save_checkpoint(src, st, epoch=2, seed=1)
+    dst_dir = tmp_path / "b"
+    dst_dir.mkdir()
+    dst = str(dst_dir / "moved.ckpt")
+    os.rename(src, dst)
+    r = restore_for_resume(dst, _state())
+    assert r.epoch == 2
+    _leaves_equal(r.state.params, st.params)
+    _leaves_equal(restore_params(dst, _state().params), st.params)
+
+
+def test_restore_rejects_architecture_mismatch(tmp_path):
+    path = str(tmp_path / "last_model.ckpt")
+    save_checkpoint(path, _state(), epoch=1)
+    other = TrainState.create({"w": np.zeros((5, 5), np.float32)},
+                              make_optimizer(1e-3))
+    with pytest.raises(ValueError, match="incompatible with model"):
+        restore_for_resume(path, other)
+
+
+# ---------------------------------------------------------------- marker
+
+def test_preempt_marker_roundtrip(tmp_path):
+    d = str(tmp_path)
+    write_preempt_marker(d, "preempt_model.ckpt", epoch=4, step_in_epoch=2)
+    marker = os.path.join(d, PREEMPT_MARKER)
+    info = json.load(open(marker))
+    assert info["checkpoint"] == "preempt_model.ckpt"
+    assert (info["epoch"], info["step_in_epoch"]) == (4, 2)
+    clear_preempt_marker(d)
+    assert not os.path.exists(marker)
+    clear_preempt_marker(d)                   # idempotent on missing

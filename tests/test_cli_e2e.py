@@ -10,6 +10,7 @@ Reference flow: main.py:95-229."""
 from __future__ import annotations
 
 import os
+import subprocess
 
 import numpy as np
 import pytest
@@ -18,6 +19,7 @@ import yaml
 import main as main_mod
 
 CONFIG_DIR = os.path.join(os.path.dirname(__file__), "..", "configs")
+REPO_DIR = os.path.abspath(os.path.join(CONFIG_DIR, ".."))
 
 
 def _patched_yaml(tmp_path, name, data_overrides, log_dir):
@@ -68,3 +70,17 @@ def test_water3d_cutoff_yaml_runs_via_main(tmp_path):
                           "radius": 0.1, "delta_t": 5}, log_dir)
     main_mod.main(["--config_path", path, "--epochs", "2", "--batch_size", "3"])
     _assert_run_artifacts(log_dir)
+
+
+def test_preempt_drill_fast(tmp_path):
+    """Tier-1 preemption drill (docs/ROBUSTNESS.md): scripts/preempt_drill.sh
+    --fast runs control → deterministic SIGTERM victim (expects exit 75 +
+    PREEMPTED marker) → --resume auto, and asserts the resumed final train
+    loss matches the control within 1e-6."""
+    env = dict(os.environ, PYTHONPATH=REPO_DIR, JAX_PLATFORMS="cpu")
+    r = subprocess.run(
+        ["bash", os.path.join(REPO_DIR, "scripts", "preempt_drill.sh"),
+         "--fast", "--workdir", str(tmp_path / "drill")],
+        capture_output=True, text=True, env=env, cwd=REPO_DIR, timeout=420)
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "DRILL PASS" in r.stdout

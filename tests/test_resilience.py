@@ -1,0 +1,245 @@
+"""Fault-tolerant training runtime (docs/ROBUSTNESS.md), proven end-to-end:
+kill a real training subprocess mid-step and show auto-resume reaches the SAME
+final train loss as an uninterrupted control — including falling back past a
+corrupted newest checkpoint. Plus the satellite recovery paths: divergence
+rollback/retry, loader open-retry, and serve-queue poison isolation /
+dispatcher restart.
+
+The subprocess under test is ``python -m distegnn_tpu.testing.tiny_run``
+(fixed data seed, fixed exp name, ~9s each on CPU) — equivalence holds because
+per-step PRNG keys and loader permutations derive from (seed, epoch, step)
+only, so a restored (state, epoch, step_in_epoch) replays the schedule
+bitwise (train/trainer.py)."""
+
+from __future__ import annotations
+
+import glob
+import json
+import os
+import pickle
+import signal
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# tiny_run's fixed layout (testing/tiny_run.py: exp_name="run")
+STATE_DIR = os.path.join("run", "state_dict")
+
+
+def run_tiny(log_dir, *extra):
+    """Run the tiny trainer as a real subprocess; returns (rc, stdout, result)
+    where result is the parsed RESULT json line (None if the process died
+    before printing it, e.g. SIGKILL)."""
+    env = dict(os.environ, PYTHONPATH=REPO, JAX_PLATFORMS="cpu")
+    proc = subprocess.run(
+        [sys.executable, "-m", "distegnn_tpu.testing.tiny_run",
+         "--log-dir", str(log_dir)] + [str(a) for a in extra],
+        capture_output=True, text=True, env=env, cwd=REPO, timeout=300)
+    result = None
+    for line in proc.stdout.splitlines():
+        if line.startswith("RESULT "):
+            result = json.loads(line[len("RESULT "):])
+    return proc.returncode, proc.stdout + proc.stderr, result
+
+
+@pytest.fixture(scope="module")
+def control_loss(tmp_path_factory):
+    """Final train loss of one uninterrupted run — the equivalence oracle
+    shared by the kill-resume and sigterm-resume tests."""
+    rc, out, result = run_tiny(tmp_path_factory.mktemp("control"))
+    assert rc == 0, out
+    assert result is not None and result["final_train_loss"] is not None
+    return result["final_train_loss"]
+
+
+# ---------------------------------------------------------------- kill/resume
+
+def test_sigkill_resume_matches_control_with_corrupt_newest(
+        tmp_path, control_loss):
+    """The ISSUE acceptance run: SIGKILL mid-epoch, corrupt the NEWEST step
+    checkpoint, and `--resume auto` must fall back to the previous valid one
+    and still reach the control's final loss within 1e-6."""
+    from distegnn_tpu.testing.faults import corrupt_checkpoint
+
+    # cadence saves every step (interval ~0) so the kill leaves step ckpts
+    rc, out, _ = run_tiny(tmp_path, "--interval-s", 0.001, "--kill-at-step", 6)
+    assert rc == -signal.SIGKILL, out
+
+    steps = sorted(glob.glob(str(tmp_path / STATE_DIR / "step_*.ckpt")))
+    assert len(steps) >= 2, f"expected cadence step checkpoints, got {steps}"
+    corrupt_checkpoint(steps[-1], mode="truncate")
+
+    rc, out, result = run_tiny(tmp_path, "--resume", "auto")
+    assert rc == 0, out
+    assert "resume: skipping" in out          # fell back past the corrupt one
+    assert "resume: restored" in out
+    assert result["start_epoch"] > 0 or result["start_step_in_epoch"] > 0
+    assert abs(result["final_train_loss"] - control_loss) <= 1e-6
+
+
+def test_sigterm_preempts_with_exit75_then_resumes(tmp_path, control_loss):
+    """Graceful preemption: SIGTERM finishes the in-flight step, writes
+    preempt_model.ckpt + the PREEMPTED marker, exits 75 (EX_TEMPFAIL), and
+    auto-resume continues to the control's final loss."""
+    rc, out, result = run_tiny(tmp_path, "--sigterm-at-step", 2)
+    assert rc == 75, out
+    assert "PREEMPTED" in out
+    assert result is not None and result["preempted"]
+    assert os.path.exists(tmp_path / STATE_DIR / "preempt_model.ckpt")
+    assert os.path.exists(tmp_path / STATE_DIR / "PREEMPTED")
+
+    rc, out, result = run_tiny(tmp_path, "--resume", "auto")
+    assert rc == 0, out
+    assert "resume: restored" in out
+    assert abs(result["final_train_loss"] - control_loss) <= 1e-6
+
+
+def test_resume_adopts_checkpoint_seed(tmp_path):
+    """A resumed run launched with the WRONG --seed must adopt the
+    checkpoint's seed (PRNG keys and permutations fold the seed — a drifted
+    seed would silently change the schedule)."""
+    rc, out, _ = run_tiny(tmp_path, "--seed", 7, "--sigterm-at-step", 2)
+    assert rc == 75, out
+    rc, out, result = run_tiny(tmp_path, "--seed", 3, "--resume", "auto")
+    assert rc == 0, out
+    assert "resume: adopting seed 7" in out
+
+
+# ---------------------------------------------------------------- divergence
+
+def test_divergence_rolls_back_and_recovers(tmp_path):
+    """One NaN batch with retries budgeted: roll back to the last finite
+    state, decay the LR, and FINISH the run (finite loss, not diverged)."""
+    rc, out, result = run_tiny(tmp_path, "--poison-at-step", 5, "--retries", 2)
+    assert rc == 0, out
+    assert "DIVERGED" in out and "rolling back" in out
+    assert result["divergence_events"] == 1
+    assert not result["diverged"]
+    assert np.isfinite(result["final_train_loss"])
+
+
+def test_divergence_retries_exhausted_declares_dead(tmp_path):
+    """With zero retries the first NaN epoch stops the run and log.json
+    records the death (the pre-existing contract, now the retry fallback)."""
+    rc, out, result = run_tiny(tmp_path, "--poison-at-step", 2, "--retries", 0)
+    assert rc == 0, out
+    assert result["diverged"]
+    log = glob.glob(str(tmp_path / "run" / "log" / "log.json"))
+    assert log, "diverged run must still write log.json"
+    best = json.load(open(log[0]))[0]
+    assert "diverged" in best
+
+
+# ---------------------------------------------------------------- data loader
+
+def test_loader_open_retries_transient_errors(tmp_path):
+    from distegnn_tpu.data.loader import GraphDataset
+    from distegnn_tpu.testing.faults import flaky_open
+
+    graphs = [{"loc": np.zeros((4, 3)), "edge_index": np.zeros((2, 6), np.int32)}]
+    src = tmp_path / "graphs.pkl"
+    with open(src, "wb") as f:
+        pickle.dump(graphs, f)
+
+    with flaky_open(fail_times=2) as calls:   # 2 hiccups < 3 attempts
+        ds = GraphDataset(str(src))
+    assert calls["n"] == 3 and len(ds) == 1
+
+    with flaky_open(fail_times=5) as calls:   # persistent failure propagates
+        with pytest.raises(OSError):
+            GraphDataset(str(src))
+    assert calls["n"] == 3                    # bounded: gave up after 3
+
+
+# ---------------------------------------------------------------- serve queue
+
+class _FakeEngine:
+    """Ladder/metrics/max_batch/predict_batch — the only surface RequestQueue
+    uses (serve/queue.py). Graphs carrying ``poison`` fail every execution."""
+
+    def __init__(self, metrics=None, max_batch=4):
+        from distegnn_tpu.serve import BucketLadder, ServeMetrics
+
+        self.ladder = BucketLadder(max_nodes=256, max_edges=1024)
+        self.metrics = metrics or ServeMetrics()
+        self.max_batch = max_batch
+
+    def predict_batch(self, graphs, bucket=None):
+        if any(g.get("poison") for g in graphs):
+            raise RuntimeError("injected poison graph")
+        return [np.zeros((g["loc"].shape[0], 3)) for g in graphs]
+
+
+def _graph(poison=False):
+    return {"loc": np.zeros((10, 3)),
+            "edge_index": np.zeros((2, 20), np.int32), "poison": poison}
+
+
+def test_queue_poison_isolated_by_solo_retry():
+    """A poison graph fails its co-batched neighbors' first execution; the
+    queue retries each request ALONE, so only the poison request errors."""
+    from distegnn_tpu.serve import RequestQueue
+
+    eng = _FakeEngine()
+    with RequestQueue(eng, batch_deadline_ms=50.0) as q:
+        goods = [q.submit(_graph()) for _ in range(2)]
+        bad = q.submit(_graph(poison=True))
+        outs = [f.result(timeout=10) for f in goods]
+        assert all(o.shape == (10, 3) for o in outs)
+        with pytest.raises(RuntimeError, match="poison"):
+            bad.result(timeout=10)
+    snap = eng.metrics.snapshot()
+    assert snap["requests_retried"] == 3      # whole batch re-tried solo
+    assert snap["requests_poison"] == 1       # only the bad one failed alone
+    assert snap["requests_failed"] == 1
+    assert snap["requests_completed"] == 2
+
+
+class _CrashingMetrics:
+    """ServeMetrics whose set_queue_depth raises ``bombs`` times — a
+    deterministic dispatcher-loop crash (a bug, not an engine error)."""
+
+    def __new__(cls, bombs):
+        from distegnn_tpu.serve import ServeMetrics
+
+        class _M(ServeMetrics):
+            def set_queue_depth(self, depth):
+                if self._bombs > 0:
+                    self._bombs -= 1
+                    raise RuntimeError("injected dispatcher crash")
+                super().set_queue_depth(depth)
+
+        m = _M()
+        m._bombs = bombs
+        return m
+
+
+def test_queue_dispatcher_restarts_after_crash():
+    from distegnn_tpu.serve import RequestQueue
+
+    eng = _FakeEngine(metrics=_CrashingMetrics(bombs=1))
+    with RequestQueue(eng, batch_deadline_ms=5.0) as q:
+        fut = q.submit(_graph())
+        out = fut.result(timeout=10)          # pending state survived restart
+        assert out.shape == (10, 3)
+    assert eng.metrics.snapshot()["worker_restarts"] == 1
+
+
+def test_queue_dispatcher_dies_cleanly_after_max_restarts():
+    """A persistent crash must FAIL outstanding futures and make submit()
+    raise — never a silent hang."""
+    from distegnn_tpu.serve import RequestQueue
+    from distegnn_tpu.serve.queue import _MAX_WORKER_RESTARTS
+
+    eng = _FakeEngine(metrics=_CrashingMetrics(bombs=10 ** 9))
+    q = RequestQueue(eng, batch_deadline_ms=5.0).start()
+    fut = q.submit(_graph())
+    with pytest.raises(RuntimeError, match="dispatcher crashed"):
+        fut.result(timeout=10)
+    with pytest.raises(RuntimeError):
+        q.submit(_graph())                    # queue declared itself dead
+    assert eng.metrics.snapshot()["worker_restarts"] == _MAX_WORKER_RESTARTS + 1

@@ -194,6 +194,25 @@ _DEFAULTS: dict = {
         # null disables the rollout endpoint
         "rollout": None,
     },
+    # observability (distegnn_tpu/obs, docs/OBSERVABILITY.md) — structured
+    # tracing + run metrics + JAX compile/memory probes. Default-on: spans
+    # and events cost ~1us each and the writer is buffered; `enable: false`
+    # is the kill switch (no event files, all hooks become no-ops).
+    "obs": {
+        "enable": True,
+        # process 0 writes <exp_dir>/obs/events.jsonl; per_host gives every
+        # process its own events_p<i>.jsonl (load-imbalance hunts)
+        "per_host": False,
+        # install the jax.monitoring compile watcher (recompiles-after-warmup
+        # are the #1 silent perf bug; see scripts/obs_report.py --check)
+        "jax_probe": True,
+        # per-step train/step events from the host epoch loop (scan-epoch
+        # runs never have them; epoch events are always emitted)
+        "step_events": True,
+        # writer buffering: flush every N events or T seconds
+        "buffer_events": 256,
+        "flush_interval_s": 2.0,
+    },
     "log": {
         "log_dir": "./logs",
         "test_interval": 2,
@@ -343,6 +362,15 @@ def validate_config(cfg: ConfigDict) -> None:
         if bool(cfg.model.normalize):
             raise ValueError("model.edge_impl='fused' does not support "
                              "model.normalize (flagship EGCL only)")
+    o = cfg.get("obs")
+    if o is not None:
+        for flag in ("enable", "per_host", "jax_probe", "step_events"):
+            if not isinstance(o.get(flag, False), bool):
+                raise ValueError(f"obs.{flag} must be a boolean")
+        if int(o.get("buffer_events", 256)) < 1:
+            raise ValueError("obs.buffer_events must be >= 1")
+        if float(o.get("flush_interval_s", 2.0)) < 0:
+            raise ValueError("obs.flush_interval_s must be >= 0")
     s = cfg.get("serve")
     if s is None:
         return  # hand-built config without the serving section

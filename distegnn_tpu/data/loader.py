@@ -17,6 +17,7 @@ from typing import List, Optional, Sequence, Union
 import numpy as np
 import jax
 
+from distegnn_tpu import obs
 from distegnn_tpu.ops.graph import GraphBatch, _round_up, pad_graphs
 
 # module-level open hook: the fault-injection harness (testing/faults.py
@@ -42,9 +43,8 @@ def _open_with_retry(path: str, mode: str = "rb"):
             if attempt == _OPEN_ATTEMPTS - 1:
                 raise
             delay = _OPEN_BACKOFF_S * (2 ** attempt)
-            print(f"loader: open {path} failed ({e!r}); retry "
-                  f"{attempt + 1}/{_OPEN_ATTEMPTS - 1} in {delay:.1f}s",
-                  flush=True)
+            obs.log(f"loader: open {path} failed ({e!r}); retry "
+                    f"{attempt + 1}/{_OPEN_ATTEMPTS - 1} in {delay:.1f}s")
             time.sleep(delay)
 
 
@@ -162,10 +162,10 @@ class GraphLoader:
             if per * len(dataset) <= cache_bytes:
                 self._prepared_cache = {}
             else:
-                print(f"GraphLoader: blockify cache OFF "
-                      f"({per * len(dataset) / 2**30:.1f} GiB > "
-                      f"{cache_bytes / 2**30:.1f} GiB budget) — every epoch re-lays "
-                      f"edges on host; raise cache_bytes if RAM allows")
+                obs.log(f"GraphLoader: blockify cache OFF "
+                        f"({per * len(dataset) / 2**30:.1f} GiB > "
+                        f"{cache_bytes / 2**30:.1f} GiB budget) — every epoch re-lays "
+                        f"edges on host; raise cache_bytes if RAM allows")
         else:
             self.edges_per_block = None
             # plain layout: pairing=True attaches the reverse-edge involution
@@ -234,11 +234,18 @@ class GraphLoader:
 
     def __iter__(self):
         order = self._order()
+        # collation time is data-stall by definition (iteration is
+        # synchronous: the trainer blocks on this generator), recorded into
+        # the global registry so step events / obs_report can attribute it
+        stall = obs.get_registry().counter("data/stall_s")
         for b in range(len(self)):
+            t0 = time.perf_counter()
             idx = order[b * self.batch_size:(b + 1) * self.batch_size]
-            yield pad_graphs(
+            batch = pad_graphs(
                 [self._graph(int(i)) for i in idx], **self.pad_kwargs(),
             )
+            stall.add(time.perf_counter() - t0)
+            yield batch
 
 
 class ShardedGraphLoader:
@@ -342,7 +349,11 @@ class ShardedGraphLoader:
 
     def __iter__(self):
         D = self.data_parallel
+        # the per-shard loaders already count their collation time; only the
+        # stack/reshape work on top of them is added here
+        stall = obs.get_registry().counter("data/stall_s")
         for parts in zip(*self.loaders):
+            t0 = time.perf_counter()
             if any(p.edge_pair is None for p in parts):
                 # pairing must be all-or-nothing for a rectangular stack
                 parts = [p.replace(edge_pair=None) for p in parts]
@@ -354,4 +365,5 @@ class ShardedGraphLoader:
                                         *x.shape[2:]).swapaxes(0, 1),
                     stacked,
                 )
+            stall.add(time.perf_counter() - t0)
             yield stacked

@@ -1,0 +1,31 @@
+"""distegnn_tpu.obs — unified observability (docs/OBSERVABILITY.md).
+
+One substrate for every runtime:
+  - ``obs.span("name")`` / ``obs.event`` / ``obs.log`` — structured tracing
+    into ``<log_dir>/obs/events.jsonl`` (``obs/trace.py``), near-zero-cost
+    no-ops until :func:`configure` binds a sink (and always under
+    ``obs.enable: false``);
+  - ``Counter`` / ``Gauge`` / ``LatencyReservoir`` / ``MetricsRegistry`` —
+    reusable run metrics with a JSON snapshot and a Prometheus-text renderer
+    (``obs/metrics.py``; the serve stack's ``ServeMetrics`` is built on
+    these);
+  - JAX-runtime probes (``obs/jaxprobe.py``): the compile watcher that
+    catches recompiles-after-warmup, device memory stats, and host<->device
+    transfer byte counters.
+
+Render a run: ``python scripts/obs_report.py <log_dir>/obs/events.jsonl``.
+"""
+
+from distegnn_tpu.obs.metrics import (Counter, Gauge, LatencyReservoir,
+                                      MetricsRegistry, REGISTRY, get_registry,
+                                      percentile)
+from distegnn_tpu.obs.trace import (EventWriter, Tracer, configure,
+                                    configure_from_config, event, flush,
+                                    get_tracer, log, span)
+
+__all__ = [
+    "Counter", "Gauge", "LatencyReservoir", "MetricsRegistry", "REGISTRY",
+    "get_registry", "percentile",
+    "EventWriter", "Tracer", "configure", "configure_from_config",
+    "event", "flush", "get_tracer", "log", "span",
+]

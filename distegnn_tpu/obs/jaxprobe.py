@@ -1,0 +1,203 @@
+"""JAX-runtime probes: compile (recompile!) watcher, device memory, transfers.
+
+The #1 silent perf bug on a shape-laddered TPU stack is a recompile after
+warmup — a shape drifting past its bucket, a weak_type flip, a donated buffer
+changing layout — which shows up only as a mysteriously slow step. XLA's
+compiles are invisible to user code EXCEPT through ``jax.monitoring``: every
+backend compile records a ``/jax/core/compile/backend_compile_duration``
+event. :class:`CompileWatcher` hooks that stream, attributes each compile to
+the phase the runtime declared (``warmup``, ``epoch<N>``, ``serve``, ...) and
+counts compiles-after-warmup separately so ``scripts/obs_report.py --check``
+can fail a run on them.
+
+Listener lifetime: ``jax.monitoring`` listeners cannot portably be removed,
+so ONE module-level listener is registered (idempotently) and dispatches to
+the currently-active watcher — re-configuring a run (or running many tests in
+one process) swaps the watcher, never stacks listeners.
+
+Also here: ``device_memory_stats()`` (``memory_stats()`` of local device 0,
+when the backend exposes it — TPU/GPU yes, CPU None) and
+:class:`TransferMeter` host->device byte accounting for loader/donation
+boundaries.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Dict, Optional
+
+from distegnn_tpu.obs import metrics as _metrics
+from distegnn_tpu.obs import trace as _trace
+
+# the jax.monitoring event marking one real backend (XLA) compile
+_COMPILE_EVENT = "/jax/core/compile/backend_compile_duration"
+
+_listener_installed = False
+_install_lock = threading.Lock()
+_active: Optional["CompileWatcher"] = None
+
+
+def _on_duration_event(event: str, duration_secs: float, **kwargs) -> None:
+    w = _active
+    if w is not None and event == _COMPILE_EVENT:
+        w._record_compile(duration_secs)
+
+
+class CompileWatcher:
+    """Counts XLA compiles and attributes them to runtime-declared phases.
+
+    Counters (global registry): ``jax/compiles`` (total),
+    ``jax/compiles_after_warmup`` (the alarm), ``jax/compile_s`` (time spent
+    compiling). Each compile also lands in the event stream as a
+    ``jax/compile`` event with its phase, so the report can render a
+    recompile table.
+    """
+
+    def __init__(self, tracer: Optional[_trace.Tracer] = None,
+                 registry: Optional[_metrics.MetricsRegistry] = None):
+        self.tracer = tracer or _trace.get_tracer()
+        self.registry = registry or _metrics.get_registry()
+        self._lock = threading.Lock()
+        self.phase = "warmup"
+        self.warmup_done = False
+        self.compiles = 0
+        self.compiles_after_warmup = 0
+
+    def set_phase(self, phase: str) -> None:
+        with self._lock:
+            self.phase = phase
+
+    def mark_warmup_done(self) -> None:
+        """Declare steady state: every compile from here on is a recompile —
+        the silent perf bug obs_report's --check gate exists to catch."""
+        with self._lock:
+            self.warmup_done = True
+
+    def _record_compile(self, duration_secs: float) -> None:
+        with self._lock:
+            self.compiles += 1
+            after = self.warmup_done
+            if after:
+                self.compiles_after_warmup += 1
+            phase = self.phase
+        self.registry.counter("jax/compiles").add(1)
+        self.registry.counter("jax/compile_s").add(duration_secs)
+        if after:
+            self.registry.counter("jax/compiles_after_warmup").add(1)
+        self.tracer.event("jax/compile", phase=phase,
+                          dur_s=round(duration_secs, 6),
+                          after_warmup=after)
+
+    def snapshot(self) -> Dict[str, Any]:
+        with self._lock:
+            return {"compiles": self.compiles,
+                    "compiles_after_warmup": self.compiles_after_warmup,
+                    "phase": self.phase, "warmup_done": self.warmup_done}
+
+
+def install_compile_watcher(tracer: Optional[_trace.Tracer] = None,
+                            registry: Optional[_metrics.MetricsRegistry] = None
+                            ) -> CompileWatcher:
+    """Install (or re-target) THE process compile watcher. The underlying
+    jax.monitoring listener registers once per process; the active watcher —
+    the one counting — is swapped atomically."""
+    global _active, _listener_installed
+    watcher = CompileWatcher(tracer, registry)
+    with _install_lock:
+        if not _listener_installed:
+            import jax.monitoring
+
+            jax.monitoring.register_event_duration_secs_listener(
+                _on_duration_event)
+            _listener_installed = True
+        _active = watcher
+    return watcher
+
+
+def get_compile_watcher() -> Optional[CompileWatcher]:
+    return _active
+
+
+def deactivate_compile_watcher() -> None:
+    """Stop counting (the listener stays registered but dispatches nowhere)."""
+    global _active
+    _active = None
+
+
+def set_phase(phase: str) -> None:
+    """Phase declaration on the active watcher; no-op when none is live, so
+    runtimes can declare phases unconditionally."""
+    w = _active
+    if w is not None:
+        w.set_phase(phase)
+
+
+def mark_warmup_done() -> None:
+    w = _active
+    if w is not None:
+        w.mark_warmup_done()
+
+
+# ---- device memory ---------------------------------------------------------
+
+def device_memory_stats() -> Dict[str, Any]:
+    """``memory_stats()`` of local device 0 when the backend exposes it
+    (TPU/GPU); {} on CPU or pre-initialization failure. Keys are
+    backend-defined (e.g. ``bytes_in_use``, ``peak_bytes_in_use``)."""
+    try:
+        import jax
+
+        dev = jax.local_devices()[0]
+        stats = dev.memory_stats() if hasattr(dev, "memory_stats") else None
+        return dict(stats) if stats else {}
+    except Exception:
+        return {}
+
+
+def emit_memory_event(tracer: Optional[_trace.Tracer] = None,
+                      name: str = "jax/memory", **attrs) -> Dict[str, Any]:
+    """Snapshot device memory into the event stream (no-op payload on CPU —
+    the event still lands, so the report can say 'no memory stats here')."""
+    t = tracer or _trace.get_tracer()
+    stats = device_memory_stats()
+    t.event(name, **{**attrs, **{k: stats[k] for k in
+                                 ("bytes_in_use", "peak_bytes_in_use",
+                                  "largest_alloc_size")
+                                 if k in stats}})
+    return stats
+
+
+# ---- host<->device transfer accounting -------------------------------------
+
+def tree_nbytes(tree) -> int:
+    """Total nbytes of the array leaves of a pytree (numpy or jax arrays)."""
+    try:
+        import jax
+
+        leaves = jax.tree.leaves(tree)
+    except Exception:
+        leaves = [tree]
+    return sum(int(getattr(l, "nbytes", 0)) for l in leaves)
+
+
+class TransferMeter:
+    """Byte counters around the host<->device boundary. The loaders/putters
+    call ``h2d(batch)`` on everything they hand to the device; fetches of
+    results call ``d2h``. Counters live in the global registry
+    (``xfer/h2d_bytes``, ``xfer/d2h_bytes``) so they appear in every
+    snapshot without plumbing."""
+
+    def __init__(self, registry: Optional[_metrics.MetricsRegistry] = None):
+        reg = registry or _metrics.get_registry()
+        self._h2d = reg.counter("xfer/h2d_bytes")
+        self._d2h = reg.counter("xfer/d2h_bytes")
+
+    def h2d(self, tree) -> int:
+        n = tree_nbytes(tree)
+        self._h2d.add(n)
+        return n
+
+    def d2h(self, tree) -> int:
+        n = tree_nbytes(tree)
+        self._d2h.add(n)
+        return n

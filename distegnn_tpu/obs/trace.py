@@ -1,0 +1,278 @@
+"""Low-overhead structured tracing: spans + events -> buffered JSONL.
+
+One process-global :class:`Tracer` (swap it with :func:`configure`) serves
+every runtime — trainer, serve stack, loaders, checkpointing. When no sink is
+configured (the default until a run calls :func:`configure`, and always under
+``obs.enable: false``) every call is a near-zero-cost no-op: ``span()``
+returns a shared null context manager and ``event()`` returns immediately, so
+instrumentation can stay in the hot paths unconditionally.
+
+Event schema (docs/OBSERVABILITY.md): one JSON object per line,
+  {"ts": <unix seconds>, "kind": "span"|"event"|"log", "name": str,
+   "proc": <process_index>, "host": <hostname>, ["dur_s": float], ...attrs}
+
+Writing is buffered (``buffer_events`` lines or ``flush_interval_s`` seconds,
+whichever first) behind one lock, appended to ``<dir>/events.jsonl``. By
+default only process 0 writes (params/metrics are replicated, and one file
+per run is what the report tooling wants); ``per_host=True`` gives every
+process its own ``events_p<i>.jsonl`` for load-imbalance hunts.
+
+``log()`` is the host-prefixed structured logger replacing bare ``print``:
+stdout stays line-compatible (the message text is unchanged; a ``[p<i>] ``
+prefix appears only on processes > 0), always flushed, and — when a sink is
+live — the same message lands in events.jsonl as a ``log`` event.
+"""
+
+from __future__ import annotations
+
+import atexit
+import json
+import os
+import socket
+import threading
+import time
+from typing import Any, Dict, Optional
+
+
+def _process_index() -> int:
+    """jax.process_index() if the backend is importable, else 0. Kept lazy so
+    importing obs never forces backend initialization."""
+    try:
+        import jax
+
+        return int(jax.process_index())
+    except Exception:
+        return 0
+
+
+class EventWriter:
+    """Thread-safe buffered JSONL appender with time/size-based flushing."""
+
+    def __init__(self, path: str, buffer_events: int = 256,
+                 flush_interval_s: float = 2.0):
+        self.path = path
+        self.buffer_events = max(int(buffer_events), 1)
+        self.flush_interval_s = float(flush_interval_s)
+        self._lock = threading.Lock()
+        self._buf: list[str] = []
+        self._last_flush = time.monotonic()
+        self._closed = False
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        # truncate: one writer per run dir, and a re-configured run (tests,
+        # resumed processes reusing a dir) must not interleave with old events
+        with open(path, "w"):
+            pass
+
+    def write(self, record: Dict[str, Any]) -> None:
+        line = json.dumps(record, separators=(",", ":"), default=repr)
+        with self._lock:
+            if self._closed:
+                return
+            self._buf.append(line)
+            if (len(self._buf) >= self.buffer_events
+                    or time.monotonic() - self._last_flush >= self.flush_interval_s):
+                self._flush_locked()
+
+    def _flush_locked(self) -> None:
+        if self._buf:
+            with open(self.path, "a") as f:
+                f.write("\n".join(self._buf) + "\n")
+            self._buf.clear()
+        self._last_flush = time.monotonic()
+
+    def flush(self) -> None:
+        with self._lock:
+            if not self._closed:
+                self._flush_locked()
+
+    def close(self) -> None:
+        with self._lock:
+            if not self._closed:
+                self._flush_locked()
+                self._closed = True
+
+
+class _NullSpan:
+    """Shared no-op context manager — the disabled-tracer fast path."""
+
+    __slots__ = ()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+    def set(self, **attrs) -> "_NullSpan":
+        return self
+
+
+_NULL_SPAN = _NullSpan()
+
+
+class _Span:
+    """Times a with-block and writes one ``span`` record at exit. Extra
+    attributes can be attached mid-flight via ``set(**attrs)``."""
+
+    __slots__ = ("_tracer", "name", "attrs", "_t0")
+
+    def __init__(self, tracer: "Tracer", name: str, attrs: Dict[str, Any]):
+        self._tracer = tracer
+        self.name = name
+        self.attrs = attrs
+
+    def set(self, **attrs) -> "_Span":
+        self.attrs.update(attrs)
+        return self
+
+    def __enter__(self) -> "_Span":
+        self._t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> bool:
+        dur = time.perf_counter() - self._t0
+        if exc_type is not None:
+            self.attrs["error"] = exc_type.__name__
+        self._tracer._emit("span", self.name, dur_s=round(dur, 6), **self.attrs)
+        return False
+
+
+class Tracer:
+    """Span/event/log emitter over an optional :class:`EventWriter` sink."""
+
+    def __init__(self, writer: Optional[EventWriter] = None,
+                 tags: Optional[Dict[str, Any]] = None,
+                 process_index: int = 0):
+        self.writer = writer
+        self.tags = dict(tags or {})
+        self.process_index = int(process_index)
+
+    @property
+    def enabled(self) -> bool:
+        return self.writer is not None
+
+    def _emit(self, kind: str, name: str, **attrs) -> None:
+        w = self.writer
+        if w is None:
+            return
+        rec = {"ts": round(time.time(), 6), "kind": kind, "name": name}
+        rec.update(self.tags)
+        rec.update(attrs)
+        w.write(rec)
+
+    def span(self, name: str, **attrs):
+        """Context manager timing a block; no-op when no sink is live."""
+        if self.writer is None:
+            return _NULL_SPAN
+        return _Span(self, name, attrs)
+
+    def event(self, name: str, **attrs) -> None:
+        self._emit("event", name, **attrs)
+
+    def log(self, msg: str, **attrs) -> None:
+        """Structured logger replacing bare ``print``: stdout-line-compatible
+        (identical text on process 0 / single-process; ``[p<i>] `` prefix on
+        other processes), always flushed, mirrored into the event stream."""
+        prefix = f"[p{self.process_index}] " if self.process_index else ""
+        print(prefix + msg, flush=True)  # noqa: obs-print (the logger itself)
+        self._emit("log", "log", msg=msg, **attrs)
+
+    def flush(self) -> None:
+        if self.writer is not None:
+            self.writer.flush()
+
+    def close(self) -> None:
+        if self.writer is not None:
+            self.writer.close()
+
+
+# ---- process-global tracer --------------------------------------------------
+
+_tracer = Tracer()          # disabled until configure() runs
+_tracer_lock = threading.Lock()
+
+
+def get_tracer() -> Tracer:
+    return _tracer
+
+
+def configure(log_dir: Optional[str] = None, enable: bool = True,
+              per_host: bool = False, buffer_events: int = 256,
+              flush_interval_s: float = 2.0,
+              tags: Optional[Dict[str, Any]] = None) -> Tracer:
+    """(Re)bind the global tracer.
+
+    ``enable=False`` or ``log_dir=None`` installs a sinkless tracer: spans and
+    events become no-ops and NO file is created (the ``obs.enable: false``
+    kill switch); ``log()`` keeps printing either way. Default sink layout:
+    process 0 writes ``<log_dir>/events.jsonl``; with ``per_host`` every
+    process writes ``<log_dir>/events_p<i>.jsonl``. Every record is tagged
+    ``proc``/``host`` (plus any extra ``tags``) so multi-host streams merge
+    unambiguously.
+    """
+    global _tracer
+    pidx = _process_index()
+    writer = None
+    if enable and log_dir is not None and (per_host or pidx == 0):
+        name = f"events_p{pidx}.jsonl" if per_host else "events.jsonl"
+        writer = EventWriter(os.path.join(log_dir, name),
+                             buffer_events=buffer_events,
+                             flush_interval_s=flush_interval_s)
+    all_tags = {"proc": pidx, "host": socket.gethostname()}
+    all_tags.update(tags or {})
+    with _tracer_lock:
+        old, _tracer = _tracer, Tracer(writer, tags=all_tags,
+                                       process_index=pidx)
+        old.close()
+    return _tracer
+
+
+def configure_from_config(config, exp_dir: str, enabled_here: bool = True,
+                          tags: Optional[Dict[str, Any]] = None) -> Tracer:
+    """Wire the tracer from a run config's ``obs:`` section (absent section =
+    defaults = on). ``enabled_here`` gates non-logging invocations (e.g.
+    ``train(log=False)`` test runs must not leave event files around).
+    Returns the tracer; also installs the compile watcher when
+    ``obs.jax_probe`` is on."""
+    o = config.get("obs") if hasattr(config, "get") else None
+    get = (lambda k, d: o.get(k, d) if o is not None else d)
+    enable = bool(get("enable", True)) and enabled_here
+    tracer = configure(
+        log_dir=os.path.join(exp_dir, "obs") if enable else None,
+        enable=enable,
+        per_host=bool(get("per_host", False)),
+        buffer_events=int(get("buffer_events", 256)),
+        flush_interval_s=float(get("flush_interval_s", 2.0)),
+        tags=tags)
+    if enable and bool(get("jax_probe", True)):
+        from distegnn_tpu.obs.jaxprobe import install_compile_watcher
+
+        install_compile_watcher(tracer)
+    return tracer
+
+
+# module-level conveniences — stable call sites that always hit the CURRENT
+# global tracer (configure() may rebind it mid-process, e.g. across tests)
+
+def span(name: str, **attrs):
+    return _tracer.span(name, **attrs)
+
+
+def event(name: str, **attrs) -> None:
+    _tracer.event(name, **attrs)
+
+
+def log(msg: str, **attrs) -> None:
+    _tracer.log(msg, **attrs)
+
+
+def flush() -> None:
+    _tracer.flush()
+
+
+@atexit.register
+def _flush_at_exit() -> None:
+    try:
+        _tracer.close()
+    except Exception:
+        pass

@@ -30,6 +30,8 @@ import jax
 import numpy as np
 from jax.sharding import NamedSharding, PartitionSpec as P
 
+from distegnn_tpu import obs
+from distegnn_tpu.obs.jaxprobe import TransferMeter
 from distegnn_tpu.parallel.mesh import DATA_AXIS, GRAPH_AXIS, make_mesh
 from distegnn_tpu.train import (
     TrainState,
@@ -132,10 +134,15 @@ def global_batch_putter(mesh):
 
 
 class _PuttingLoader:
-    """Wrap a loader so every yielded batch goes through global_batch_putter."""
+    """Wrap a loader so every yielded batch goes through global_batch_putter.
+
+    The put is part of the data stall by definition (the trainer blocks on
+    this generator), so its time joins the loader's ``data/stall_s`` counter;
+    the batch bytes feed the ``xfer/h2d_bytes`` transfer meter."""
 
     def __init__(self, loader, put):
         self.loader, self.put = loader, put
+        self._meter = TransferMeter()
 
     def set_epoch(self, epoch):
         self.loader.set_epoch(epoch)
@@ -144,8 +151,15 @@ class _PuttingLoader:
         return len(self.loader)
 
     def __iter__(self):
+        import time as _time
+
+        stall = obs.get_registry().counter("data/stall_s")
         for batch in self.loader:
-            yield self.put(batch)
+            t0 = _time.perf_counter()
+            self._meter.h2d(batch)
+            out = self.put(batch)
+            stall.add(_time.perf_counter() - t0)
+            yield out
 
 
 def _dispatch_preprocess(config, ws: int):
@@ -245,8 +259,8 @@ def run_distributed(config):
                      else None),
         ), put))
     loader_train, loader_valid, loader_test = loaders
-    print(f"Data ready: {len(loader_train.loader.loaders[0].dataset)} graphs x "
-          f"{ws} partitions x {dp} data shards")
+    obs.log(f"Data ready: {len(loader_train.loader.loaders[0].dataset)} graphs x "
+            f"{ws} partitions x {dp} data shards")
 
     model = get_model(config.model, world_size=ws, dataset_name=name, axis_name=GRAPH_AXIS)
     # init outside shard_map on the raw HOST batch (the axis name is unbound
@@ -257,7 +271,7 @@ def run_distributed(config):
     params = model.copy(axis_name=None).init(
         jax.random.PRNGKey(config.seed), jax.tree.map(strip0, sample))
     n_params = sum(int(np.prod(p.shape)) for p in jax.tree.leaves(params))
-    print(f"Model: {config.model.model_name}, {n_params} parameters, mesh graph={ws}")
+    obs.log(f"Model: {config.model.model_name}, {n_params} parameters, mesh graph={ws}")
 
     total_steps = config.train.epochs * len(loader_train) // config.train.accumulation_steps
     clip = 0.3 if needs_grad_clip(config) else None
@@ -277,11 +291,11 @@ def run_distributed(config):
     if resumed is not None:
         state, start_epoch = resumed.state, resumed.epoch
         start_step_in_epoch = resumed.step_in_epoch
-        print(f"resume: restored {resumed.path} (epoch {start_epoch} + "
-              f"{start_step_in_epoch} step(s) applied)")
+        obs.log(f"resume: restored {resumed.path} (epoch {start_epoch} + "
+                f"{start_step_in_epoch} step(s) applied)")
     elif config.model.checkpoint:
         state, start_epoch, _ = restore_checkpoint(config.model.checkpoint, state)
-        print(f"Checkpoint loaded from {config.model.checkpoint} (epoch {start_epoch})")
+        obs.log(f"Checkpoint loaded from {config.model.checkpoint} (epoch {start_epoch})")
 
     is_fast = config.model.model_name.startswith("Fast")
     mmd_w = config.train.mmd.weight if is_fast else 0.0
@@ -327,8 +341,8 @@ def run_distributed(config):
         scan_runner = DistributedScanRunner(
             dstep, dev, mesh, loader_train.loader, config.seed,
             loader_valid=loader_valid.loader, loader_test=loader_test.loader)
-        print(f"scan_epochs: on ({total / 2**30:.2f} GiB device-resident "
-              f"per chip)")
+        obs.log(f"scan_epochs: on ({total / 2**30:.2f} GiB device-resident "
+                f"per chip)")
 
     state, best_state, best, log_dict = train(
         state, train_step, eval_step, loader_train, loader_valid, loader_test,
@@ -336,7 +350,7 @@ def run_distributed(config):
         start_step_in_epoch=start_step_in_epoch, step_factory=step_factory,
     )
     if best.get("preempted"):
-        print(f"Preempted (resumable). Best so far: {best}")
+        obs.log(f"Preempted (resumable). Best so far: {best}")
     else:
-        print(f"Done. Best: {best}")
+        obs.log(f"Done. Best: {best}")
     return best

@@ -34,6 +34,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from distegnn_tpu import obs
+from distegnn_tpu.obs import jaxprobe
 from distegnn_tpu.serve.buckets import Bucket, BucketLadder
 from distegnn_tpu.serve.metrics import ServeMetrics
 
@@ -107,6 +109,9 @@ class InferenceEngine:
             fn = build()
             self._cache[key] = fn
             self.metrics.cache_event(hit=False, evicted=evicted)
+            # cache misses land on the event stream: a miss AFTER warmup is
+            # either an un-warmed rung (fine, once) or an eviction storm
+            obs.event("serve/cache_miss", key=repr(key), evicted=evicted)
             return fn
 
     def cache_stats(self) -> Dict[str, int]:
@@ -146,7 +151,9 @@ class InferenceEngine:
         fn = self._compiled(("predict", batch.max_nodes, batch.max_edges,
                              batch.edge_block, rpad, self.max_batch),
                             lambda: self._build_predict(bucket))
-        x = np.asarray(fn(self.params, batch))           # [max_batch, N, 3]
+        with obs.span("serve/execute", n=batch.max_nodes, e=batch.max_edges,
+                      filled=n_real, capacity=self.max_batch):
+            x = np.asarray(fn(self.params, batch))       # [max_batch, N, 3]
         return [x[i, : graphs[i]["loc"].shape[0]].copy()
                 for i in range(n_real)]
 
@@ -159,18 +166,22 @@ class InferenceEngine:
         sizes (distinct rungs only). Returns the warmed buckets."""
         from distegnn_tpu.serve.buckets import synthetic_graph
 
+        jaxprobe.set_phase("serve_warmup")
         warmed: List[Bucket] = []
-        for n, e in sizes:
-            b = self.ladder.bucket_for(n, e)
-            if b in warmed:
-                continue
-            # a tiny probe graph: the compiled shape is fixed by (bucket,
-            # max_batch) alone, and padding admits any graph under the rung
-            g = synthetic_graph(2, seed=0,
-                                feat_nf=self._probe_feat_nf(),
-                                edge_attr_nf=self._probe_edge_attr_nf())
-            self.predict_batch([g], bucket=b)
-            warmed.append(b)
+        with obs.span("serve/warmup", rungs=0) as sp:
+            for n, e in sizes:
+                b = self.ladder.bucket_for(n, e)
+                if b in warmed:
+                    continue
+                # a tiny probe graph: the compiled shape is fixed by (bucket,
+                # max_batch) alone, and padding admits any graph under the rung
+                g = synthetic_graph(2, seed=0,
+                                    feat_nf=self._probe_feat_nf(),
+                                    edge_attr_nf=self._probe_edge_attr_nf())
+                self.predict_batch([g], bucket=b)
+                warmed.append(b)
+            sp.set(rungs=len(warmed))
+        jaxprobe.set_phase("serve")
         return warmed
 
     def _probe_feat_nf(self) -> int:

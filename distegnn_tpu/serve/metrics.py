@@ -1,28 +1,35 @@
 """Serving metrics — thread-safe counters + a JSON-able snapshot.
 
 One `ServeMetrics` instance is shared by the engine (compile cache, execute
-latencies) and the batcher (queue depth, fill ratio, rejections). Everything
-is a plain counter or a bounded latency reservoir guarded by one lock — the
-serving hot path adds microseconds, never blocks on I/O.
+latencies) and the batcher (queue depth, fill ratio, rejections). Since the
+obs subsystem landed (docs/OBSERVABILITY.md) this is a thin facade over the
+shared ``distegnn_tpu.obs.metrics`` primitives — ``Counter`` / ``Gauge`` /
+``LatencyReservoir`` in a private ``MetricsRegistry`` — so the serving hot
+path still adds microseconds and never blocks on I/O, and the same registry
+renders Prometheus text via :meth:`ServeMetrics.render_prometheus`.
 
-Snapshot schema (docs/SERVING.md "Metrics"): every field is a number, so the
-snapshot is directly a Prometheus-style scrape body or one BENCH JSON line.
+Snapshot schema (docs/SERVING.md "Metrics") is unchanged: every field is a
+number, so the snapshot is directly one BENCH JSON line.
 """
 
 from __future__ import annotations
 
 import json
-import threading
 import time
 from typing import Dict, List, Optional
 
+from distegnn_tpu.obs.metrics import MetricsRegistry
+from distegnn_tpu.obs.metrics import percentile as _percentile  # noqa: F401
+# _percentile is re-exported for back-compat: this module used to own the
+# nearest-rank implementation; obs.metrics.percentile is now THE one
 
-def _percentile(sorted_vals: List[float], q: float) -> float:
-    """Nearest-rank percentile on an ascending list (0 <= q <= 100)."""
-    if not sorted_vals:
-        return 0.0
-    idx = min(len(sorted_vals) - 1, max(0, int(round(q / 100.0 * (len(sorted_vals) - 1)))))
-    return sorted_vals[idx]
+_COUNTERS = (
+    "requests_submitted", "requests_completed", "requests_failed",
+    "requests_timeout", "requests_rejected", "requests_retried",
+    "requests_poison", "worker_restarts", "batches_executed",
+    "batch_slots_total", "batch_slots_filled",
+    "cache_hits", "cache_misses", "cache_evictions",
+)
 
 
 class ServeMetrics:
@@ -30,118 +37,110 @@ class ServeMetrics:
 
     Latencies are recorded in milliseconds into a bounded reservoir (the most
     recent ``reservoir`` samples) — p50/p99 are computed at snapshot time, so
-    the record path is O(1).
+    the record path is O(1). Counter values stay readable as plain int
+    attributes (``metrics.requests_submitted``) for existing callers.
     """
 
     def __init__(self, reservoir: int = 8192):
-        self._lock = threading.Lock()
-        self._reservoir = int(reservoir)
+        self._registry = MetricsRegistry()
         self._t0 = time.perf_counter()
-        self._lat_ms: List[float] = []
-        self._queue_ms: List[float] = []
-        self.requests_submitted = 0
-        self.requests_completed = 0
-        self.requests_failed = 0      # engine/model errors surfaced on futures
-        self.requests_timeout = 0     # deadline passed while queued
-        self.requests_rejected = 0    # bounded-queue backpressure (submit fails)
-        self.requests_retried = 0     # re-executed individually after a batch failure
-        self.requests_poison = 0      # failed even alone (the bad graph itself)
-        self.worker_restarts = 0      # dispatcher thread died and was restarted
-        self.batches_executed = 0
-        self.batch_slots_total = 0    # sum of padded batch capacity over batches
-        self.batch_slots_filled = 0   # sum of real requests over batches
-        self.cache_hits = 0
-        self.cache_misses = 0
-        self.cache_evictions = 0
-        self.queue_depth = 0          # gauge, set by the batcher
+        self._c = {name: self._registry.counter("serve/" + name)
+                   for name in _COUNTERS}
+        self._qdepth = self._registry.gauge("serve/queue_depth")
+        self._lat = self._registry.reservoir("serve/latency_ms",
+                                             size=int(reservoir))
+        self._queue = self._registry.reservoir("serve/queue_wait_ms",
+                                               size=int(reservoir))
+
+    def __getattr__(self, name: str):
+        # attribute back-compat: counters/gauge read as plain numbers
+        c = self.__dict__.get("_c") or {}
+        if name in c:
+            return int(c[name].value)
+        if name == "queue_depth":
+            return int(self.__dict__["_qdepth"].value)
+        raise AttributeError(name)
+
+    @property
+    def registry(self) -> MetricsRegistry:
+        return self._registry
 
     # ---- recorders -------------------------------------------------------
     def submitted(self, n: int = 1) -> None:
-        with self._lock:
-            self.requests_submitted += n
+        self._c["requests_submitted"].add(n)
 
     def rejected(self, n: int = 1) -> None:
-        with self._lock:
-            self.requests_rejected += n
+        self._c["requests_rejected"].add(n)
 
     def timed_out(self, n: int = 1) -> None:
-        with self._lock:
-            self.requests_timeout += n
+        self._c["requests_timeout"].add(n)
 
     def failed(self, n: int = 1) -> None:
-        with self._lock:
-            self.requests_failed += n
+        self._c["requests_failed"].add(n)
 
     def retried(self, n: int = 1) -> None:
-        with self._lock:
-            self.requests_retried += n
+        self._c["requests_retried"].add(n)
 
     def poison(self, n: int = 1) -> None:
-        with self._lock:
-            self.requests_poison += n
+        self._c["requests_poison"].add(n)
 
     def worker_restarted(self, n: int = 1) -> None:
-        with self._lock:
-            self.worker_restarts += n
+        self._c["worker_restarts"].add(n)
 
     def batch_done(self, filled: int, capacity: int,
                    latencies_ms: List[float],
                    queue_ms_each: Optional[List[float]] = None) -> None:
         """One executed micro-batch: ``filled`` real requests padded to
         ``capacity`` slots, with one end-to-end latency per request."""
-        with self._lock:
-            self.batches_executed += 1
-            self.batch_slots_total += capacity
-            self.batch_slots_filled += filled
-            self.requests_completed += filled
-            self._lat_ms.extend(latencies_ms)
-            if queue_ms_each:
-                self._queue_ms.extend(queue_ms_each)
-            del self._lat_ms[:-self._reservoir]
-            del self._queue_ms[:-self._reservoir]
+        self._c["batches_executed"].add(1)
+        self._c["batch_slots_total"].add(capacity)
+        self._c["batch_slots_filled"].add(filled)
+        self._c["requests_completed"].add(filled)
+        self._lat.record_many(latencies_ms)
+        if queue_ms_each:
+            self._queue.record_many(queue_ms_each)
 
     def cache_event(self, hit: bool, evicted: int = 0) -> None:
-        with self._lock:
-            if hit:
-                self.cache_hits += 1
-            else:
-                self.cache_misses += 1
-            self.cache_evictions += evicted
+        self._c["cache_hits" if hit else "cache_misses"].add(1)
+        if evicted:
+            self._c["cache_evictions"].add(evicted)
 
     def set_queue_depth(self, depth: int) -> None:
-        with self._lock:
-            self.queue_depth = depth
+        self._qdepth.set(depth)
 
     # ---- export ----------------------------------------------------------
     def snapshot(self) -> Dict[str, float]:
-        with self._lock:
-            lat = sorted(self._lat_ms)
-            qms = sorted(self._queue_ms)
-            elapsed = max(time.perf_counter() - self._t0, 1e-9)
-            fill = (self.batch_slots_filled / self.batch_slots_total
-                    if self.batch_slots_total else 0.0)
-            return {
-                "uptime_s": round(elapsed, 3),
-                "requests_submitted": self.requests_submitted,
-                "requests_completed": self.requests_completed,
-                "requests_failed": self.requests_failed,
-                "requests_timeout": self.requests_timeout,
-                "requests_rejected": self.requests_rejected,
-                "requests_retried": self.requests_retried,
-                "requests_poison": self.requests_poison,
-                "worker_restarts": self.worker_restarts,
-                "requests_per_sec": round(self.requests_completed / elapsed, 3),
-                "batches_executed": self.batches_executed,
-                "batch_fill_ratio": round(fill, 4),
-                "latency_p50_ms": round(_percentile(lat, 50), 3),
-                "latency_p99_ms": round(_percentile(lat, 99), 3),
-                "queue_wait_p50_ms": round(_percentile(qms, 50), 3),
-                "queue_wait_p99_ms": round(_percentile(qms, 99), 3),
-                "cache_hits": self.cache_hits,
-                "cache_misses": self.cache_misses,
-                "cache_evictions": self.cache_evictions,
-                "queue_depth": self.queue_depth,
-            }
+        c = {name: int(cnt.value) for name, cnt in self._c.items()}
+        elapsed = max(time.perf_counter() - self._t0, 1e-9)
+        fill = (c["batch_slots_filled"] / c["batch_slots_total"]
+                if c["batch_slots_total"] else 0.0)
+        return {
+            "uptime_s": round(elapsed, 3),
+            "requests_submitted": c["requests_submitted"],
+            "requests_completed": c["requests_completed"],
+            "requests_failed": c["requests_failed"],
+            "requests_timeout": c["requests_timeout"],
+            "requests_rejected": c["requests_rejected"],
+            "requests_retried": c["requests_retried"],
+            "requests_poison": c["requests_poison"],
+            "worker_restarts": c["worker_restarts"],
+            "requests_per_sec": round(c["requests_completed"] / elapsed, 3),
+            "batches_executed": c["batches_executed"],
+            "batch_fill_ratio": round(fill, 4),
+            "latency_p50_ms": round(self._lat.percentile(50), 3),
+            "latency_p99_ms": round(self._lat.percentile(99), 3),
+            "queue_wait_p50_ms": round(self._queue.percentile(50), 3),
+            "queue_wait_p99_ms": round(self._queue.percentile(99), 3),
+            "cache_hits": c["cache_hits"],
+            "cache_misses": c["cache_misses"],
+            "cache_evictions": c["cache_evictions"],
+            "queue_depth": int(self._qdepth.value),
+        }
 
     def to_json(self) -> str:
         return json.dumps(self.snapshot(), sort_keys=True)
+
+    def render_prometheus(self, prefix: str = "distegnn") -> str:
+        """Prometheus text exposition of the underlying registry (the obs
+        subsystem's renderer; docs/SERVING.md "Metrics")."""
+        return self._registry.render_prometheus(prefix=prefix)

@@ -30,6 +30,7 @@ import threading
 import time
 from typing import Dict, List, Optional
 
+from distegnn_tpu import obs
 from distegnn_tpu.serve.buckets import Bucket, BucketLadder, BucketOverflowError
 from distegnn_tpu.serve.engine import InferenceEngine
 from distegnn_tpu.serve.metrics import ServeMetrics
@@ -185,15 +186,14 @@ class RequestQueue:
                 self._restarts += 1
                 self.metrics.worker_restarted()
                 if self._restarts > _MAX_WORKER_RESTARTS:
-                    print(f"serve: dispatcher died permanently after "
-                          f"{_MAX_WORKER_RESTARTS} restarts: {exc!r}",
-                          flush=True)
+                    obs.log(f"serve: dispatcher died permanently after "
+                            f"{_MAX_WORKER_RESTARTS} restarts: {exc!r}")
                     self._fail_all(RuntimeError(
                         f"serve dispatcher crashed: {exc!r}"))
                     self._started = False
                     return
-                print(f"serve: dispatcher crashed ({exc!r}); restarting "
-                      f"({self._restarts}/{_MAX_WORKER_RESTARTS})", flush=True)
+                obs.log(f"serve: dispatcher crashed ({exc!r}); restarting "
+                        f"({self._restarts}/{_MAX_WORKER_RESTARTS})")
 
     def _next_flush_deadline(self) -> Optional[float]:
         ts = [rs[0].t_submit + self.batch_deadline
@@ -277,6 +277,9 @@ class RequestQueue:
         lats = [(now - r.t_submit) * 1e3 for r in reqs]
         qms = [(t_start - r.t_submit) * 1e3 for r in reqs]
         self.metrics.batch_done(len(reqs), self.engine.max_batch, lats, qms)
+        obs.event("serve/batch", n=bucket.n, e=bucket.e, filled=len(reqs),
+                  capacity=self.engine.max_batch,
+                  dur_s=round(now - t_start, 6))
         for r, out in zip(reqs, outs):
             r.future.set_result(out)
 

@@ -40,6 +40,8 @@ from typing import Any, List, Optional
 import jax
 import numpy as np
 
+from distegnn_tpu import obs
+
 MANIFEST_NAME = "manifest.json"
 PREEMPT_MARKER = "PREEMPTED"
 
@@ -62,6 +64,9 @@ class CheckpointCorruptError(RuntimeError):
         super().__init__(f"corrupt checkpoint {path}: {reason}")
         self.path = path
         self.reason = reason
+        # every detected corruption lands on the obs fault timeline (no-op
+        # when no sink is configured) — raise sites are many, this is one
+        obs.event("ckpt/corrupt", path=os.path.basename(path), reason=reason)
 
 
 @dataclass
@@ -137,7 +142,7 @@ def _sweep_stale_tmps(ckpt_dir: str) -> None:
     for stale in glob.glob(os.path.join(ckpt_dir, "*.tmp")):
         try:
             os.remove(stale)
-            print(f"checkpoint: removed stale partial write {stale}", flush=True)
+            obs.log(f"checkpoint: removed stale partial write {stale}")
         except OSError:
             pass
 
@@ -154,6 +159,9 @@ def save_checkpoint(path: str, state, epoch: int, losses: Optional[dict] = None,
     a resumed run replays the schedule from exactly there."""
     if jax.process_index() != 0:
         return
+    import time as _time
+
+    t0 = _time.perf_counter()
     payload = {
         "epoch": int(epoch),
         "params_leaves": _to_leaves(state.params),
@@ -174,8 +182,6 @@ def save_checkpoint(path: str, state, epoch: int, losses: Optional[dict] = None,
         f.flush()
         os.fsync(f.fileno())
     os.replace(tmp, path)  # atomic: a crash never leaves a torn checkpoint
-    import time as _time
-
     manifest = read_manifest(ckpt_dir)
     manifest[os.path.basename(path)] = {
         "crc32": zlib.crc32(blob) & 0xFFFFFFFF,
@@ -189,6 +195,8 @@ def save_checkpoint(path: str, state, epoch: int, losses: Optional[dict] = None,
     manifest = {k: v for k, v in manifest.items()
                 if os.path.exists(os.path.join(ckpt_dir, k))}
     _write_manifest(ckpt_dir, manifest)
+    obs.event("ckpt/save", path=os.path.basename(path), epoch=int(epoch),
+              bytes=len(blob), dur_s=round(_time.perf_counter() - t0, 6))
 
 
 _STEP_RE = re.compile(r"^step_(\d+)\.ckpt$")
@@ -264,6 +272,9 @@ def restore_for_resume(path: str, state) -> RestoredRun:
     The optimizer configuration must match the one the checkpoint was written
     with (grad-accumulation wrapping changes the opt-state tree);
     evaluation-only consumers should use :func:`restore_params` instead."""
+    import time as _time
+
+    t0 = _time.perf_counter()
     payload = verify_checkpoint(path)
     from distegnn_tpu.train.step import TrainState
 
@@ -275,6 +286,10 @@ def restore_for_resume(path: str, state) -> RestoredRun:
         )
     except ValueError as e:
         raise _with_config_hint(payload, e) from None
+    obs.event("ckpt/restore", path=os.path.basename(path),
+              epoch=int(payload["epoch"]),
+              bytes=int(os.path.getsize(path)) if os.path.exists(path) else 0,
+              dur_s=round(_time.perf_counter() - t0, 6))
     return RestoredRun(
         state=restored,
         epoch=int(payload["epoch"]),
@@ -340,9 +355,9 @@ def find_resume_checkpoint(log_dir: str, state) -> Optional[RestoredRun]:
         try:
             return restore_for_resume(path, state)
         except CheckpointCorruptError as e:
-            print(f"resume: skipping {path} ({e.reason})", flush=True)
+            obs.log(f"resume: skipping {path} ({e.reason})")
         except ValueError as e:
-            print(f"resume: skipping incompatible {path} ({e})", flush=True)
+            obs.log(f"resume: skipping incompatible {path} ({e})")
     return None
 
 
@@ -362,9 +377,8 @@ def adopt_resume_seed(config) -> None:
         except CheckpointCorruptError:
             return  # resolve_resume raises the loud, typed error
     if seed is not None and int(seed) != int(config.seed):
-        print(f"resume: adopting seed {seed} from {path} (config had "
-              f"{config.seed}) so the resumed run replays the schedule",
-              flush=True)
+        obs.log(f"resume: adopting seed {seed} from {path} (config had "
+                f"{config.seed}) so the resumed run replays the schedule")
         config.seed = int(seed)
 
 
@@ -378,8 +392,8 @@ def resolve_resume(config, state) -> Optional[RestoredRun]:
     if resume == "auto":
         rr = find_resume_checkpoint(config.log.log_dir, state)
         if rr is None:
-            print("resume: auto found no valid checkpoint under "
-                  f"{config.log.log_dir}; starting fresh", flush=True)
+            obs.log("resume: auto found no valid checkpoint under "
+                    f"{config.log.log_dir}; starting fresh")
         return rr
     return restore_for_resume(resume, state)
 

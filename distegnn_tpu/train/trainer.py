@@ -35,6 +35,9 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from distegnn_tpu import obs
+from distegnn_tpu.obs import jaxprobe
+
 
 def _fmt(loss: float) -> str:
     """Loss for humans: fixed-point at ordinary scales, scientific once the
@@ -70,8 +73,8 @@ class PreemptionGuard:
             raise KeyboardInterrupt(f"second signal {signum} during preemption")
         self.requested = True
         self.signum = signum
-        print(f"preemption: caught signal {signum}; finishing the in-flight "
-              "step and checkpointing", flush=True)
+        obs.log(f"preemption: caught signal {signum}; finishing the in-flight "
+                "step and checkpointing", signal=signum)
 
     def install(self) -> "PreemptionGuard":
         if threading.current_thread() is not threading.main_thread():
@@ -129,7 +132,8 @@ class CadenceSaver:
 def run_epoch_train(train_step: Callable, state, loader, seed: int, epoch: int,
                     start_step: int = 0,
                     guard: Optional[PreemptionGuard] = None,
-                    cadence: Optional[CadenceSaver] = None):
+                    cadence: Optional[CadenceSaver] = None,
+                    tracer=None, step_events: bool = False):
     """One training epoch. Returns (state, avg loss) — the average of the
     per-step node-weighted global MSE weighted by batch size (reference
     result['loss']/result['counter'], utils/train.py:29,112-114).
@@ -144,19 +148,40 @@ def run_epoch_train(train_step: Callable, state, loader, seed: int, epoch: int,
     order and per-step PRNG keys derive from (seed, epoch, step_idx) only, so
     skipping replays the exact schedule). The returned average then covers
     the resumed span only. ``guard``/``cadence`` hook preemption checks and
-    wall-clock checkpointing between steps (docs/ROBUSTNESS.md)."""
+    wall-clock checkpointing between steps (docs/ROBUSTNESS.md).
+
+    ``tracer``/``step_events``: emit one ``train/step`` event per step with
+    the host-observed dispatch time and the loader-stall delta since the
+    previous step (the loaders add their collation/put time to the global
+    ``data/stall_s`` counter; reading the delta here attributes it per step
+    without a second clock in the loader's hot path)."""
     loader.set_epoch(epoch)
     try:
         steps_total = len(loader)
     except TypeError:
         steps_total = None
+    reg = obs.get_registry()
+    stall_c = reg.counter("data/stall_s")
+    step_res = reg.reservoir("train/step_ms")
+    emit = step_events and tracer is not None and tracer.enabled
+    stall_prev = stall_c.value
     total, counter, cons = None, 0.0, None
     for step_idx, batch in enumerate(loader):
         if step_idx < start_step:
+            stall_prev = stall_c.value
             continue  # applied before the checkpoint this run resumed from
         key = jax.random.PRNGKey(seed)
         key = jax.random.fold_in(jax.random.fold_in(key, epoch), step_idx)
+        t_step = time.perf_counter()
         state, metrics = train_step(state, batch, key)
+        dt_step = time.perf_counter() - t_step
+        step_res.record(1e3 * dt_step)
+        if emit:
+            stall_now = stall_c.value
+            tracer.event("train/step", epoch=epoch, step=step_idx,
+                         dur_s=round(dt_step, 6),
+                         stall_s=round(stall_now - stall_prev, 6))
+            stall_prev = stall_now
         bsz = batch.loc.shape[-3] if batch.loc.ndim == 4 else batch.loc.shape[0]
         contrib = metrics["loss"] * bsz
         total = contrib if total is None else total + contrib
@@ -258,6 +283,22 @@ def train(
         os.makedirs(ckpt_dir, exist_ok=True)
         if log_cfg.wandb.enable:
             wandb_run = _init_wandb(config, exp_dir)
+    # observability (docs/OBSERVABILITY.md): bind the event sink under this
+    # run's exp_dir and point the compile watcher at it. log=False runs
+    # (tests, replay harnesses) stay sinkless — no files, no-op spans.
+    obs_cfg = config.get("obs") or {}
+    tracer = obs.configure_from_config(
+        config, exp_dir, enabled_here=log,
+        tags={"run": log_cfg.get("exp_name", "run")})
+    step_events = bool(obs_cfg.get("step_events", True))
+    stall_c = obs.get_registry().counter("data/stall_s")
+    tracer.event("train/run_start", start_epoch=start_epoch,
+                 epochs=int(train_cfg.epochs),
+                 scan_epochs=scan_runner is not None,
+                 devices=jax.device_count(), processes=jax.process_count())
+    if start_epoch or start_step_in_epoch:
+        tracer.event("train/resume", epoch=start_epoch,
+                     step_in_epoch=int(start_step_in_epoch or 0))
     start = time.perf_counter()
 
     cfg_dict = config.to_dict() if hasattr(config, "to_dict") else dict(config)
@@ -288,10 +329,13 @@ def train(
                             completed_epoch, config=cfg_dict, seed=seed,
                             step_in_epoch=step_in_epoch)
             write_preempt_marker(ckpt_dir, name, completed_epoch, step_in_epoch)
-            print(f"PREEMPTED (signal {guard.signum}): checkpointed "
-                  f"epoch {completed_epoch} + {step_in_epoch} step(s) to "
-                  f"{os.path.join(ckpt_dir, name)}; resume with "
-                  "train.resume: auto", flush=True)
+            obs.log(f"PREEMPTED (signal {guard.signum}): checkpointed "
+                    f"epoch {completed_epoch} + {step_in_epoch} step(s) to "
+                    f"{os.path.join(ckpt_dir, name)}; resume with "
+                    "train.resume: auto")
+        tracer.event("train/preempt", epoch=completed_epoch,
+                     step_in_epoch=step_in_epoch, signal=guard.signum)
+        tracer.flush()
         best["preempted"] = {"epoch": completed_epoch,
                              "step_in_epoch": step_in_epoch,
                              "signal": guard.signum,
@@ -301,9 +345,12 @@ def train(
     try:
         epoch = start_epoch  # last COMPLETED epoch; the loop body runs epoch+1
         resume_step = int(start_step_in_epoch or 0)
+        warmup_marked = False
         while epoch < train_cfg.epochs:
             epoch += 1
+            jaxprobe.set_phase(f"epoch{epoch}")
             t_epoch = time.perf_counter()
+            stall_e0 = stall_c.value
             # optional device trace of exactly one epoch (log.trace_epoch):
             # SURVEY §5.1 observability — the per-op timeline behind the
             # epoch_time numbers, viewable in TensorBoard/Perfetto
@@ -322,11 +369,12 @@ def train(
             else:
                 state, loss_train = run_epoch_train(
                     train_step, state, loader_train, seed, epoch,
-                    start_step=resume_step, guard=guard, cadence=cadence)
+                    start_step=resume_step, guard=guard, cadence=cadence,
+                    tracer=tracer, step_events=step_events)
             resume_step = 0  # only the first resumed epoch skips steps
             if tracing:
                 jax.profiler.stop_trace()
-                print(f"profiler trace of epoch {epoch} written to {trace_dir}", flush=True)
+                obs.log(f"profiler trace of epoch {epoch} written to {trace_dir}")
             dt_epoch = time.perf_counter() - t_epoch
 
             # preemption mid-epoch: the state holds a PARTIAL epoch — checkpoint
@@ -342,6 +390,11 @@ def train(
             # log.json; the fetch of loss_train above is the epoch's one host sync,
             # so dt_epoch covers the full device time of the epoch
             log_dict["epoch_time"].append(round(dt_epoch, 4))
+            tracer.event(
+                "train/epoch", epoch=epoch, dur_s=round(dt_epoch, 4),
+                stall_s=round(stall_c.value - stall_e0, 4),
+                loss_train=(loss_train if np.isfinite(loss_train)
+                            else repr(loss_train)))
 
             # failure detection (SURVEY §5.3, beyond reference parity): a
             # diverged run never recovers on its own, and unattended hardware
@@ -373,11 +426,17 @@ def train(
                         {"epoch": epoch, "loss_train": repr(loss_train),
                          "rolled_back_to": snap_epoch, "lr_scale": lr_scale,
                          "retries_left": retries_left})
+                    tracer.event("train/divergence", epoch=epoch,
+                                 loss_train=repr(loss_train),
+                                 retries_left=retries_left)
+                    tracer.event("train/rollback", epoch=epoch,
+                                 rolled_back_to=snap_epoch,
+                                 lr_scale=round(lr_scale, 6))
                     if is_main:
-                        print(f"DIVERGED at epoch {epoch}: train loss {loss_train}"
-                              f"; rolling back to epoch {snap_epoch} state, "
-                              f"lr_scale={lr_scale:g} ({retries_left} retries "
-                              "left)", flush=True)
+                        obs.log(f"DIVERGED at epoch {epoch}: train loss {loss_train}"
+                                f"; rolling back to epoch {snap_epoch} state, "
+                                f"lr_scale={lr_scale:g} ({retries_left} retries "
+                                "left)")
                     epoch = snap_epoch
                     continue
                 # repr(), not the float: json.dump would emit a bare NaN token,
@@ -385,10 +444,12 @@ def train(
                 best["diverged"] = {"epoch": epoch, "loss_train": repr(loss_train),
                                     "retries_exhausted":
                                         int(train_cfg.get("divergence_retries", 0) or 0)}
+                tracer.event("train/divergence", epoch=epoch,
+                             loss_train=repr(loss_train), fatal=True)
                 if is_main:
-                    print(f"DIVERGED at epoch {epoch}: train loss {loss_train}; "
-                          "stopping (divergence retries exhausted — resume from "
-                          "the last checkpoint with a lower lr)", flush=True)
+                    obs.log(f"DIVERGED at epoch {epoch}: train loss {loss_train}; "
+                            "stopping (divergence retries exhausted — resume from "
+                            "the last checkpoint with a lower lr)")
                 _write_log_json(log_dir, best, log_dict, config, start, is_main and log)
                 break
             finite_snap = (state, epoch, len(log_dict["loss_train"]),
@@ -402,12 +463,23 @@ def train(
                 break
 
             if epoch % log_cfg.test_interval == 0:
+                t_eval = time.perf_counter()
                 if scan_runner is not None:
                     loss_valid = scan_runner.eval_epoch(state.params, "valid")
                     loss_test = scan_runner.eval_epoch(state.params, "test")
                 else:
                     loss_valid = run_epoch_eval(eval_step, state.params, loader_valid)
                     loss_test = run_epoch_eval(eval_step, state.params, loader_test)
+                tracer.event("train/eval", epoch=epoch,
+                             dur_s=round(time.perf_counter() - t_eval, 4),
+                             loss_valid=float(loss_valid),
+                             loss_test=float(loss_test))
+                if not warmup_marked:
+                    # eval_step compiles at the FIRST eval epoch — only once
+                    # both train and eval programs have run is every further
+                    # compile a true (alarm-worthy) recompile
+                    warmup_marked = True
+                    jaxprobe.mark_warmup_done()
                 if log_cfg.get("check_consistency", True):
                     from distegnn_tpu.parallel.checks import assert_replicated
 
@@ -429,12 +501,12 @@ def train(
                         wandb_run.log({"loss_train": loss_train, "loss_valid": loss_valid,
                                        "loss_test": loss_test, "epoch_time": dt_epoch},
                                       step=epoch)
-                    print(f"Epoch {epoch} | train {_fmt(loss_train)} | "
-                          f"valid {_fmt(loss_valid)} | test {_fmt(loss_test)} | "
-                          f"{dt_epoch:.2f}s/epoch", flush=True)
-                    print(f"*** Best Valid Loss: {_fmt(best['loss_valid'])} | "
-                          f"Best Test Loss: {_fmt(best['loss_test'])} | "
-                          f"Best Epoch Index: {best['epoch_index']}", flush=True)
+                    obs.log(f"Epoch {epoch} | train {_fmt(loss_train)} | "
+                            f"valid {_fmt(loss_valid)} | test {_fmt(loss_test)} | "
+                            f"{dt_epoch:.2f}s/epoch")
+                    obs.log(f"*** Best Valid Loss: {_fmt(best['loss_valid'])} | "
+                            f"Best Test Loss: {_fmt(best['loss_test'])} | "
+                            f"Best Epoch Index: {best['epoch_index']}")
 
             elif is_main and log and wandb_run is not None:
                 wandb_run.log({"loss_train": loss_train, "epoch_time": dt_epoch},
@@ -445,7 +517,7 @@ def train(
             if epoch - best["epoch_index"] >= train_cfg.early_stop:
                 best["early_stop"] = epoch
                 if is_main:
-                    print(f"Early stopped! Epoch: {epoch}")
+                    obs.log(f"Early stopped! Epoch: {epoch}")
                 _write_log_json(log_dir, best, log_dict, config, start, is_main and log)
                 break
 
@@ -453,6 +525,7 @@ def train(
 
     finally:
         guard.uninstall()
+        tracer.flush()
     if wandb_run is not None:
         wandb_run.log({"best_test_loss": best["loss_test"]})
         wandb_run.finish()

@@ -18,6 +18,7 @@ import sys
 import jax
 import numpy as np
 
+from distegnn_tpu import obs
 from distegnn_tpu.config import build_arg_parser, derive_runtime_fields, load_config
 from distegnn_tpu.data import GraphDataset, GraphLoader, process_nbody_cutoff
 from distegnn_tpu.models.registry import get_model
@@ -60,8 +61,8 @@ def init_multihost():
         )
     else:
         jax.distributed.initialize()
-    print(f"multihost: process {jax.process_index()}/{jax.process_count()}, "
-          f"{len(jax.local_devices())} local / {len(jax.devices())} global devices")
+    obs.log(f"multihost: process {jax.process_index()}/{jax.process_count()}, "
+            f"{len(jax.local_devices())} local / {len(jax.devices())} global devices")
 
 
 def process_dataset_edge_cutoff(data_cfg, seed: int = 0):
@@ -112,7 +113,9 @@ def main(argv=None):
         except ImportError as e:
             raise NotImplementedError("distribute mode not built yet (SURVEY.md §7.2 stage 6)") from e
 
-        return run_distributed(config)
+        best = run_distributed(config)
+        _point_at_events()
+        return best
 
     # cutoff_edges mode is single-device by contract (reference main.py:173
     # asserts world_size == 1); an explicit conflicting --world_size is an error
@@ -127,7 +130,7 @@ def main(argv=None):
     files = process_dataset_edge_cutoff(config.data, seed=config.seed)
     ds_train, ds_valid, ds_test = (
         GraphDataset(f, node_order=config.data.node_order) for f in files)
-    print(f"Data ready: {len(ds_train)}/{len(ds_valid)}/{len(ds_test)} graphs")
+    obs.log(f"Data ready: {len(ds_train)}/{len(ds_valid)}/{len(ds_test)} graphs")
     mk = lambda ds, shuffle: GraphLoader(
         ds, config.data.batch_size, shuffle=shuffle, seed=config.seed,
         node_bucket=config.data.node_bucket, edge_bucket=config.data.edge_bucket,
@@ -144,7 +147,7 @@ def main(argv=None):
     model = get_model(config.model, world_size=1, dataset_name=config.data.dataset_name)
     sample = next(iter(loader_train))
     params = model.init(jax.random.PRNGKey(config.seed), sample)
-    print(f"Model: {config.model.model_name}, {count_parameters(params)} parameters")
+    obs.log(f"Model: {config.model.model_name}, {count_parameters(params)} parameters")
 
     # Optimizer (+ reference clip rule and cosine schedule option)
     total_steps = config.train.epochs * len(loader_train) // config.train.accumulation_steps
@@ -180,11 +183,11 @@ def main(argv=None):
     if resumed is not None:
         state, start_epoch = resumed.state, resumed.epoch
         start_step_in_epoch = resumed.step_in_epoch
-        print(f"resume: restored {resumed.path} (epoch {start_epoch} + "
-              f"{start_step_in_epoch} step(s) applied)")
+        obs.log(f"resume: restored {resumed.path} (epoch {start_epoch} + "
+                f"{start_step_in_epoch} step(s) applied)")
     elif config.model.checkpoint:
         state, start_epoch, _ = restore_checkpoint(config.model.checkpoint, state)
-        print(f"Checkpoint loaded from {config.model.checkpoint} (epoch {start_epoch})")
+        obs.log(f"Checkpoint loaded from {config.model.checkpoint} (epoch {start_epoch})")
 
     train_step = step_factory(1.0)
     eval_step = jax.jit(make_eval_step(model))
@@ -204,7 +207,7 @@ def main(argv=None):
         scan_runner = ScanEpochRunner(
             train_step, eval_step, loader_train, config.seed,
             loader_valid=loader_valid, loader_test=loader_test)
-        print(f"scan_epochs: on ({total / 2**30:.2f} GiB device-resident)")
+        obs.log(f"scan_epochs: on ({total / 2**30:.2f} GiB device-resident)")
 
     state, best_state, best, log_dict = train(
         state, train_step, eval_step, loader_train, loader_valid, loader_test,
@@ -212,10 +215,22 @@ def main(argv=None):
         start_step_in_epoch=start_step_in_epoch, step_factory=step_factory,
     )
     if best.get("preempted"):
-        print(f"Preempted (resumable). Best so far: {best}")
+        obs.log(f"Preempted (resumable). Best so far: {best}")
     else:
-        print(f"Done. Best: {best}")
+        obs.log(f"Done. Best: {best}")
+    _point_at_events()
     return best
+
+
+def _point_at_events():
+    """Flush the event stream and tell the operator where it landed (and how
+    to render it) — the obs analog of the log.json pointer."""
+    tracer = obs.get_tracer()
+    tracer.flush()
+    w = getattr(tracer, "writer", None)
+    if w is not None:
+        obs.log(f"obs: events at {w.path}; render with "
+                f"python scripts/obs_report.py {w.path}")
 
 
 if __name__ == "__main__":

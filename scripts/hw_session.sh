@@ -314,6 +314,12 @@ largefluid_epoch_and_check() {
   [ -n "$L" ] || return 1
   mkdir -p docs/artifacts
   cp "$L" docs/artifacts/largefluid_epoch_log.json
+  # obs event stream (step/stall/compile timeline) next to the log artifact —
+  # scripts/obs_report.py renders it; --check would flag recompiles-after-
+  # warmup on the real backend
+  E=$(ls -t logs/largefluid/*/obs/events.jsonl 2>/dev/null | head -1)
+  [ -n "$E" ] && cp "$E" \
+    "docs/artifacts/largefluid_epoch_events_$(date -u +%Y%m%dT%H%M%S).jsonl"
 }
 run largefluid_epoch largefluid_epoch_and_check
 

@@ -1,0 +1,60 @@
+#!/usr/bin/env python
+"""Render an obs events.jsonl stream as a human-readable run report.
+
+Usage:
+  python scripts/obs_report.py <events.jsonl> [--json] [--check]
+
+  --json    emit the summary dict as one JSON object instead of text
+  --check   CI gate: exit 1 if the stream has ZERO events (telemetry dead)
+            or ANY recompile after warmup (the silent shape-ladder bug);
+            failures are printed to stderr after the report
+
+The heavy lifting lives in distegnn_tpu.obs.report (pure functions over
+parsed events) so tests drive it without a subprocess. Typical sources:
+  <log_dir>/<exp_name>/obs/events.jsonl    (training, process 0)
+  logs/serve_bench/obs/events.jsonl        (scripts/serve_bench.py)
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+from distegnn_tpu.obs.report import check, load_events, render_text, summarize
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("events", help="path to an events.jsonl file")
+    ap.add_argument("--json", action="store_true", dest="as_json",
+                    help="emit the summary as JSON instead of text")
+    ap.add_argument("--check", action="store_true",
+                    help="exit 1 on zero events or recompiles after warmup")
+    args = ap.parse_args(argv)
+
+    if not os.path.exists(args.events):
+        print(f"obs_report: no such file: {args.events}", file=sys.stderr)
+        return 2
+    events, bad = load_events(args.events)
+    summary = summarize(events)
+    if args.as_json:
+        print(json.dumps({**summary, "bad_lines": bad}, sort_keys=True))
+    else:
+        print(render_text(summary, source=args.events, bad_lines=bad), end="")
+
+    if args.check:
+        fails = check(summary)
+        for f in fails:
+            print(f"obs_report --check FAIL: {f}", file=sys.stderr)
+        if fails:
+            return 1
+        print("obs_report --check: OK", file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

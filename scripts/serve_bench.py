@@ -12,6 +12,12 @@ CPU works (JAX_PLATFORMS=cpu); the same harness runs unchanged on TPU.
 
   python scripts/serve_bench.py --config_path configs/nbody_serve.yaml \
       --requests 64 --rate 200 --sizes 48,96,192
+
+Obs: the run's structured event stream (serve/batch, serve/execute,
+jax/compile, ...) lands at --obs-dir/obs/events.jsonl (default
+logs/serve_bench/, gitignored) so hw_session.sh can archive it next to the
+BENCH line; render with `python scripts/obs_report.py <path>`. Stdout stays
+EXACTLY one JSON line — the obs pointer goes to stderr.
 """
 
 from __future__ import annotations
@@ -56,18 +62,30 @@ def main(argv=None) -> int:
     ap.add_argument("--seed", type=int, default=43)
     ap.add_argument("--no-warmup", action="store_true",
                     help="include first-request compiles in the timed window")
+    ap.add_argument("--obs-dir", type=str, default="logs/serve_bench",
+                    help="event-stream sink dir (events land at <dir>/obs/"
+                         "events.jsonl); '' disables tracing")
     args = ap.parse_args(argv)
 
+    from distegnn_tpu import obs
     from distegnn_tpu.config import ConfigDict, _DEFAULTS, load_config
+    from distegnn_tpu.obs import jaxprobe
 
     cfg = (load_config(args.config_path) if args.config_path
            else ConfigDict(_DEFAULTS))
+    if args.obs_dir:
+        obs.configure_from_config(cfg, args.obs_dir,
+                                  tags={"run": "serve_bench"})
     sizes = [int(s) for s in args.sizes.split(",") if s]
     engine, q, graphs = _build(cfg, sizes, args.seed)
 
     if not args.no_warmup:
         engine.warmup([(g["loc"].shape[0], g["edge_index"].shape[1])
                        for g in graphs])
+    # compiles past this point are regressions obs_report --check flags
+    jaxprobe.mark_warmup_done()
+    obs.event("serve/bench_start", requests=args.requests, rate=args.rate,
+              sizes=sizes, warmup=not args.no_warmup)
 
     futures, rejected = [], 0
     t0 = time.perf_counter()
@@ -104,6 +122,15 @@ def main(argv=None) -> int:
         "snapshot": snap,
     }
     print(json.dumps(rec, sort_keys=True))
+
+    tracer = obs.get_tracer()
+    tracer.flush()
+    w = getattr(tracer, "writer", None)
+    if w is not None:
+        # stderr: stdout is contractually the single JSON line above
+        print(f"obs: events at {w.path}; render with "
+              f"python scripts/obs_report.py {w.path}",
+              file=sys.stderr, flush=True)  # noqa: obs-print
     return 0 if completed else 1
 
 

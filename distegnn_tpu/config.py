@@ -190,9 +190,28 @@ _DEFAULTS: dict = {
         # donation: 'auto' = donate on TPU only (CPU ignores donation)
         "cache_size": 32,
         "donate": "auto",
+        # hard-deadline headroom on top of request_timeout_ms: a no-timeout
+        # ServeFuture.result() waits at most timeout+margin, so a wedged
+        # dispatcher surfaces as RequestTimeoutError (the gateway's 504)
+        "result_margin_s": 30.0,
         # optional K-step rollout serving (rollout.make_rollout_fn kwargs);
         # null disables the rollout endpoint
         "rollout": None,
+        # multi-model routing (serve/registry.py): null = one model from
+        # THIS config; else a list of {name, config_path?, overrides?}
+        # entries, each owning its own engine + queue + warmup
+        "models": None,
+        # HTTP transport front-end (serve/transport.py,
+        # scripts/serve_gateway.py): bind address, gateway-level inflight
+        # shed gate (429 before the queue sees the request), drain grace,
+        # and the synthetic node counts warmed per model at startup
+        "gateway": {
+            "host": "127.0.0.1",
+            "port": 8008,
+            "max_inflight": 64,
+            "drain_grace_s": 10.0,
+            "warmup_nodes": [48, 96],
+        },
     },
     # observability (distegnn_tpu/obs, docs/OBSERVABILITY.md) — structured
     # tracing + run metrics + JAX compile/memory probes. Default-on: spans
@@ -385,6 +404,42 @@ def validate_config(cfg: ConfigDict) -> None:
                          "serve.request_timeout_ms > 0")
     if s.donate not in (True, False, "auto"):
         raise ValueError("serve.donate must be true, false, or 'auto'")
+    if float(s.get("result_margin_s", 30.0)) <= 0:
+        raise ValueError("serve.result_margin_s must be > 0")
+    models = s.get("models")
+    if models is not None:
+        if not isinstance(models, (list, tuple)) or not models:
+            raise ValueError("serve.models must be null or a non-empty list "
+                             "of {name, config_path?, overrides?} entries")
+        seen = set()
+        for item in models:
+            if not isinstance(item, Mapping) or not item.get("name"):
+                raise ValueError("each serve.models entry needs a 'name'")
+            name = str(item["name"])
+            if name in seen:
+                raise ValueError(f"duplicate serve.models name {name!r}")
+            seen.add(name)
+            for key in item:
+                if key not in ("name", "config_path", "overrides"):
+                    raise ValueError(f"serve.models[{name!r}]: unknown key "
+                                     f"{key!r}")
+            if item.get("overrides") is not None and not isinstance(
+                    item["overrides"], Mapping):
+                raise ValueError(f"serve.models[{name!r}].overrides must be "
+                                 "a mapping")
+    g = s.get("gateway")
+    if g is not None:
+        if int(g.get("max_inflight", 64)) < 1:
+            raise ValueError("serve.gateway.max_inflight must be >= 1")
+        if not 0 <= int(g.get("port", 8008)) <= 65535:
+            raise ValueError("serve.gateway.port must be in [0, 65535]")
+        if float(g.get("drain_grace_s", 10.0)) < 0:
+            raise ValueError("serve.gateway.drain_grace_s must be >= 0")
+        nodes = g.get("warmup_nodes", [48, 96])
+        if (not isinstance(nodes, (list, tuple)) or not nodes
+                or any(int(n) < 2 for n in nodes)):
+            raise ValueError("serve.gateway.warmup_nodes must be a "
+                             "non-empty list of node counts >= 2")
 
 
 def derive_runtime_fields(cfg: ConfigDict, world_size: Optional[int] = None) -> ConfigDict:

@@ -1,8 +1,10 @@
 """distegnn_tpu.serve — bucketed-batching inference (docs/SERVING.md).
 
-Request path: RequestQueue.submit(graph) -> bucket ladder -> micro-batcher
--> InferenceEngine per-bucket compile cache -> ServeFuture result. All
-components share one ServeMetrics snapshot.
+Request path: HTTP gateway (transport.py) -> ModelRegistry route ->
+RequestQueue.submit(graph) -> bucket ladder -> micro-batcher ->
+InferenceEngine per-bucket compile cache -> ServeFuture result. All
+components of one model share one ServeMetrics snapshot; the gateway adds
+process-wide admission/latency series and a /metrics scrape endpoint.
 """
 
 from distegnn_tpu.serve.buckets import (Bucket, BucketLadder,
@@ -16,8 +18,24 @@ __all__ = [
     "Bucket", "BucketLadder", "BucketOverflowError", "synthetic_graph",
     "InferenceEngine", "RolloutOverflowError", "ServeMetrics",
     "QueueFullError", "RequestQueue", "RequestTimeoutError", "ServeFuture",
-    "engine_from_config",
+    "engine_from_config", "Gateway", "ModelEntry", "ModelRegistry",
+    "PayloadError",
 ]
+
+
+def __getattr__(name):
+    # transport/registry import lazily: the in-process serve stack must not
+    # pay for (or depend on) the HTTP layer, and registry->engine_from_config
+    # would otherwise be a load-time cycle through this package __init__
+    if name in ("Gateway", "PayloadError"):
+        from distegnn_tpu.serve import transport
+
+        return getattr(transport, name)
+    if name in ("ModelEntry", "ModelRegistry"):
+        from distegnn_tpu.serve import registry
+
+        return getattr(registry, name)
+    raise AttributeError(name)
 
 
 def engine_from_config(cfg, model, params, metrics=None):
@@ -42,5 +60,7 @@ def engine_from_config(cfg, model, params, metrics=None):
     q = RequestQueue(
         engine, batch_deadline_ms=s.batch_deadline_ms,
         queue_capacity=s.queue_capacity,
-        request_timeout_ms=s.request_timeout_ms, metrics=metrics)
+        request_timeout_ms=s.request_timeout_ms,
+        result_margin_s=float(s.get("result_margin_s", 30.0)),
+        metrics=metrics)
     return engine, q

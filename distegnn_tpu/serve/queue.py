@@ -45,12 +45,23 @@ class RequestTimeoutError(RuntimeError):
 
 
 class ServeFuture:
-    """Minimal one-shot future (no asyncio dependency in the serving core)."""
+    """Minimal one-shot future (no asyncio dependency in the serving core).
 
-    def __init__(self):
+    ``hard_deadline`` (absolute ``time.perf_counter()`` seconds) is the
+    belt-and-suspenders bound the queue stamps on every request: a
+    ``result()`` call with no explicit timeout waits at most until then, so
+    a wedged dispatcher surfaces as :class:`RequestTimeoutError` (the
+    gateway's 504) instead of a hung caller. ``meta`` is filled by the
+    dispatcher before resolution (queue_ms / compute_ms / batch_filled /
+    bucket) for transports that report per-request timing.
+    """
+
+    def __init__(self, hard_deadline: Optional[float] = None):
         self._event = threading.Event()
         self._result = None
         self._exc: Optional[BaseException] = None
+        self._hard_deadline = hard_deadline
+        self.meta: dict = {}
 
     def set_result(self, value) -> None:
         self._result = value
@@ -64,7 +75,14 @@ class ServeFuture:
         return self._event.is_set()
 
     def result(self, timeout: Optional[float] = None):
-        if not self._event.wait(timeout):
+        if timeout is None and self._hard_deadline is not None:
+            remaining = max(self._hard_deadline - time.perf_counter(), 0.0)
+            if not self._event.wait(remaining):
+                raise RequestTimeoutError(
+                    "request passed its hard deadline with no dispatcher "
+                    "progress (dispatcher wedged or overloaded past the "
+                    "result margin)")
+        elif not self._event.wait(timeout):
             raise TimeoutError("serve future not ready")
         if self._exc is not None:
             raise self._exc
@@ -74,10 +92,11 @@ class ServeFuture:
 class _Request:
     __slots__ = ("graph", "bucket", "future", "t_submit", "deadline")
 
-    def __init__(self, graph: dict, bucket: Bucket, deadline: float):
+    def __init__(self, graph: dict, bucket: Bucket, deadline: float,
+                 hard_deadline: Optional[float] = None):
         self.graph = graph
         self.bucket = bucket
-        self.future = ServeFuture()
+        self.future = ServeFuture(hard_deadline=hard_deadline)
         self.t_submit = time.perf_counter()
         self.deadline = deadline
 
@@ -100,21 +119,32 @@ class RequestQueue:
       queue_capacity: ingress bound; submits beyond it raise QueueFullError.
       request_timeout_ms: per-request deadline (queued time only — an
         admitted request that starts executing always completes).
+      result_margin_s: execute-time headroom added on top of the queued
+        deadline to form each future's HARD deadline — a no-timeout
+        ``ServeFuture.result()`` never waits longer than
+        ``request_timeout + result_margin``, so a wedged dispatcher is a
+        typed RequestTimeoutError, not a hang.
     """
 
     def __init__(self, engine: InferenceEngine, *,
                  batch_deadline_ms: float = 5.0, queue_capacity: int = 256,
                  request_timeout_ms: float = 1000.0,
+                 result_margin_s: float = 30.0,
                  metrics: Optional[ServeMetrics] = None):
         self.engine = engine
         self.metrics = metrics or engine.metrics
         self.batch_deadline = batch_deadline_ms / 1e3
         self.request_timeout = request_timeout_ms / 1e3
+        self.result_margin = float(result_margin_s)
         self._ingress: "_pyqueue.Queue" = _pyqueue.Queue(maxsize=queue_capacity)
         self._pending: Dict[Bucket, List[_Request]] = {}
         self._thread: Optional[threading.Thread] = None
         self._started = False
         self._restarts = 0
+        # stop() coordination: idempotent and signal-safe — any number of
+        # threads (SIGTERM handler, bench atexit, with-block) may race it
+        self._stop_lock = threading.Lock()
+        self._stop_begun = False
 
     @property
     def ladder(self) -> BucketLadder:
@@ -124,23 +154,53 @@ class RequestQueue:
     def start(self) -> "RequestQueue":
         if self._started:
             return self
+        with self._stop_lock:
+            self._stop_begun = False
         self._started = True
         self._thread = threading.Thread(target=self._run,
                                         name="serve-dispatch", daemon=True)
         self._thread.start()
         return self
 
+    def alive(self) -> bool:
+        """True while the dispatcher thread is accepting and running."""
+        t = self._thread
+        return bool(self._started and t is not None and t.is_alive())
+
     def stop(self, drain: bool = True) -> None:
         """Stop the dispatcher. ``drain=True`` flushes everything already
-        admitted; False fails pending futures with RequestTimeoutError."""
-        if not self._started:
+        admitted; False fails pending futures with RequestTimeoutError.
+
+        Idempotent and signal-safe: double-stop, stop-before-start, and
+        concurrent stops (the gateway's SIGTERM drain racing a bench's
+        with-block exit) never raise, block indefinitely, or strand a
+        future. Only the first caller delivers the STOP; later callers just
+        wait for the dispatcher to finish.
+        """
+        with self._stop_lock:
+            first = not self._stop_begun
+            self._stop_begun = True
+            thread = self._thread
+        if thread is None:
+            # stop before start: nothing is running and nothing was admitted
+            self._started = False
             return
-        self._ingress.put((_STOP, drain))
-        self._thread.join(timeout=30.0)
-        self._started = False
-        # a submit racing the final drain check could leave a request in the
-        # ingress after the dispatcher exited — fail it, never strand it
-        self._fail_all(RequestTimeoutError("server stopped"))
+        if first:
+            self._started = False   # reject new submits while stopping
+            # never block forever handing over the STOP: a full ingress with
+            # a live dispatcher drains; a dead dispatcher can't take it
+            while thread.is_alive():
+                try:
+                    self._ingress.put((_STOP, drain), timeout=0.05)
+                    break
+                except _pyqueue.Full:
+                    continue
+        thread.join(timeout=30.0)
+        if first:
+            # a submit racing the final drain check could leave a request in
+            # the ingress after the dispatcher exited — fail it, never
+            # strand it
+            self._fail_all(RequestTimeoutError("server stopped"))
 
     def __enter__(self) -> "RequestQueue":
         return self.start()
@@ -156,8 +216,10 @@ class RequestQueue:
             raise RuntimeError("RequestQueue not started (use start() or a "
                                "with-block)")
         bucket = self.ladder.bucket_of_graph(graph)  # BucketOverflowError here
-        req = _Request(graph, bucket,
-                       deadline=time.perf_counter() + self.request_timeout)
+        now = time.perf_counter()
+        req = _Request(graph, bucket, deadline=now + self.request_timeout,
+                       hard_deadline=(now + self.request_timeout
+                                      + self.result_margin))
         try:
             self._ingress.put_nowait(req)
         except _pyqueue.Full:
@@ -280,7 +342,12 @@ class RequestQueue:
         obs.event("serve/batch", n=bucket.n, e=bucket.e, filled=len(reqs),
                   capacity=self.engine.max_batch,
                   dur_s=round(now - t_start, 6))
-        for r, out in zip(reqs, outs):
+        compute_ms = round((now - t_start) * 1e3, 3)
+        for r, out, q_ms in zip(reqs, outs, qms):
+            r.future.meta.update(queue_ms=round(q_ms, 3),
+                                 compute_ms=compute_ms,
+                                 batch_filled=len(reqs),
+                                 bucket_n=bucket.n, bucket_e=bucket.e)
             r.future.set_result(out)
 
     def _retry_individually(self, bucket: Bucket, reqs: List[_Request]) -> None:
@@ -298,6 +365,10 @@ class RequestQueue:
             self.metrics.batch_done(1, self.engine.max_batch,
                                     [(now - r.t_submit) * 1e3],
                                     [(t_start - r.t_submit) * 1e3])
+            r.future.meta.update(
+                queue_ms=round((t_start - r.t_submit) * 1e3, 3),
+                compute_ms=round((now - t_start) * 1e3, 3),
+                batch_filled=1, bucket_n=bucket.n, bucket_e=bucket.e)
             r.future.set_result(out)
 
     def _fail_all(self, exc: BaseException) -> None:

@@ -1,0 +1,189 @@
+"""Multi-model routing — name -> (engine, queue, warmup state).
+
+One gateway process fronts N independently-configured models (the
+``serve.models:`` config list): each :class:`ModelEntry` owns its own
+InferenceEngine (compile cache, ladder), RequestQueue (micro-batcher,
+admission), ServeMetrics, and warmup state, so one model's traffic or
+compile storm never perturbs another's rungs. The registry is the routing
+table the HTTP transport (``serve/transport.py``) resolves
+``/v1/models/<name>/...`` against, and the single lifecycle handle the
+gateway's SIGTERM drain walks (start all -> warm all -> stop(drain=True)
+all — queue.stop is idempotent, so a bench or atexit racing the drain is
+harmless).
+
+Params come from ``model.checkpoint`` when set (verified restore via
+``train/checkpoint.restore_params``); otherwise the entry initializes
+random params from the config seed — the synthetic-load/bench path.
+"""
+
+from __future__ import annotations
+
+import copy
+from typing import Dict, List, Optional, Sequence
+
+from distegnn_tpu import obs
+from distegnn_tpu.serve.buckets import Bucket, synthetic_graph
+from distegnn_tpu.serve.engine import InferenceEngine
+from distegnn_tpu.serve.queue import RequestQueue
+
+
+class ModelEntry:
+    """One served model: engine + queue + warmup state, owned by a name."""
+
+    def __init__(self, name: str, engine: InferenceEngine,
+                 queue: RequestQueue, feat_nf: int, edge_attr_nf: int,
+                 config=None):
+        self.name = name
+        self.engine = engine
+        self.queue = queue
+        self.feat_nf = int(feat_nf)
+        self.edge_attr_nf = int(edge_attr_nf)
+        self.config = config
+        self.warmed: List[Bucket] = []
+        self.state = "cold"            # cold -> ready | failed
+        self.error: Optional[str] = None
+
+    def warmup(self, nodes: Sequence[int]) -> None:
+        """Pre-compile the rungs admitting synthetic graphs of the given
+        node counts; flips state to 'ready' (or 'failed', kept servable so
+        /v1/models can show WHY readiness is down)."""
+        try:
+            sizes = []
+            for n in nodes:
+                g = synthetic_graph(int(n), seed=0, feat_nf=self.feat_nf,
+                                    edge_attr_nf=self.edge_attr_nf)
+                sizes.append((int(g["loc"].shape[0]),
+                              int(g["edge_index"].shape[1])))
+            self.warmed = self.engine.warmup(sizes)
+            self.state = "ready"
+        except Exception as exc:
+            self.state, self.error = "failed", repr(exc)
+            obs.event("gateway/warmup_failed", model=self.name,
+                      error=repr(exc))
+
+    def alive(self) -> bool:
+        return self.queue.alive()
+
+    def describe(self) -> dict:
+        snap = self.engine.metrics.snapshot()
+        return {
+            "name": self.name,
+            "state": self.state,
+            "error": self.error,
+            "dispatcher_alive": self.alive(),
+            "warmed_rungs": [[b.n, b.e] for b in self.warmed],
+            "max_batch": self.engine.max_batch,
+            "ladder": {"max_nodes": self.engine.ladder.max_nodes,
+                       "max_edges": self.engine.ladder.max_edges},
+            "queue_depth": self.queue.depth(),
+            "requests_completed": snap["requests_completed"],
+        }
+
+
+class ModelRegistry:
+    """name -> ModelEntry routing table + one lifecycle handle."""
+
+    def __init__(self, entries: Dict[str, ModelEntry]):
+        if not entries:
+            raise ValueError("ModelRegistry needs at least one model entry")
+        self._entries = dict(entries)
+
+    # ---- construction ----------------------------------------------------
+    @classmethod
+    def from_config(cls, cfg, default_name: str = "default") -> "ModelRegistry":
+        """Build from a config: the ``serve.models:`` list (each item a
+        mapping with ``name`` + optional ``config_path``/``overrides``), or
+        — when the list is absent — ONE entry from the config itself."""
+        from distegnn_tpu.config import (ConfigDict, _merge, load_config,
+                                         validate_config)
+
+        models = cfg.serve.get("models") or None
+        entries: Dict[str, ModelEntry] = {}
+        if not models:
+            entries[default_name] = cls._build_entry(default_name, cfg)
+            return cls(entries)
+        for item in models:
+            name = str(item["name"])
+            if item.get("config_path"):
+                m_cfg = load_config(str(item["config_path"]))
+            else:
+                m_cfg = ConfigDict(copy.deepcopy(cfg.to_dict()))
+            overrides = item.get("overrides")
+            if overrides:
+                m_cfg = ConfigDict(_merge(m_cfg.to_dict(),
+                                          dict(overrides)))
+                validate_config(m_cfg)
+            entries[name] = cls._build_entry(name, m_cfg)
+        return cls(entries)
+
+    @staticmethod
+    def _build_entry(name: str, cfg) -> ModelEntry:
+        import jax
+
+        from distegnn_tpu.models.registry import get_model
+        from distegnn_tpu.serve import engine_from_config
+
+        model = get_model(cfg.model, dataset_name=cfg.data.dataset_name)
+        engine, queue = engine_from_config(cfg, model, params=None)
+        feat_nf = int(cfg.model.node_feat_nf)
+        edge_nf = int(cfg.model.edge_attr_nf)
+        seed = int(cfg.get("seed", 0) or 0)
+        g = synthetic_graph(2, seed=seed, feat_nf=feat_nf,
+                            edge_attr_nf=edge_nf)
+        b0 = engine.ladder.bucket_of_graph(g)
+        init_batch, _ = engine.ladder.pad_batch([g], b0, 1,
+                                                **engine._layout_opts)
+        params = model.init(jax.random.PRNGKey(seed), init_batch)
+        ckpt = cfg.model.get("checkpoint")
+        if ckpt:
+            from distegnn_tpu.train.checkpoint import restore_params
+
+            params = restore_params(ckpt, params)
+            obs.event("gateway/params_restored", model=name, path=str(ckpt))
+        engine.params = params
+        return ModelEntry(name, engine, queue, feat_nf, edge_nf, config=cfg)
+
+    @classmethod
+    def single(cls, name: str, engine: InferenceEngine, queue: RequestQueue,
+               feat_nf: int = 1, edge_attr_nf: int = 2) -> "ModelRegistry":
+        """Wrap one pre-built engine/queue pair (the bench's http mode and
+        the transport tests)."""
+        return cls({name: ModelEntry(name, engine, queue, feat_nf,
+                                     edge_attr_nf)})
+
+    # ---- routing ---------------------------------------------------------
+    def get(self, name: str) -> ModelEntry:
+        return self._entries[name]      # KeyError -> the transport's 404
+
+    def names(self) -> List[str]:
+        return sorted(self._entries)
+
+    def items(self):
+        return sorted(self._entries.items())
+
+    def __len__(self) -> int:
+        return len(self._entries)
+
+    # ---- lifecycle -------------------------------------------------------
+    def start(self) -> "ModelRegistry":
+        for _, e in self.items():
+            e.queue.start()
+        return self
+
+    def warmup(self, nodes: Sequence[int]) -> None:
+        for _, e in self.items():
+            e.warmup(nodes)
+
+    def stop(self, drain: bool = True) -> None:
+        """Stop every queue (idempotent; safe from a SIGTERM handler thread
+        racing other shutdown paths)."""
+        for _, e in self.items():
+            e.queue.stop(drain=drain)
+
+    def ready(self) -> bool:
+        """All models warmed and their dispatcher threads alive."""
+        return all(e.state == "ready" and e.alive()
+                   for e in self._entries.values())
+
+    def describe(self) -> dict:
+        return {"models": [e.describe() for _, e in self.items()]}

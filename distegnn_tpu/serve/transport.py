@@ -1,0 +1,469 @@
+"""HTTP transport front-end — the serving stack's network edge.
+
+A stdlib-only gateway (``http.server.ThreadingHTTPServer``, zero new
+dependencies) over one or more :class:`~distegnn_tpu.serve.queue.RequestQueue`
+instances routed by a :class:`~distegnn_tpu.serve.registry.ModelRegistry`:
+
+  POST /v1/models/<name>/predict   JSON graph -> prediction (+ bucket,
+                                   queue_ms, compute_ms, batch_filled)
+  GET  /v1/models                  routing table: rungs, warmup state, depth
+  GET  /metrics                    Prometheus text: the process-wide obs
+                                   MetricsRegistry + each model's serve
+                                   registry (per-model name prefix)
+  GET  /healthz                    process up (always 200)
+  GET  /readyz                     200 only when accepting AND every model
+                                   is warmed with a live dispatcher
+
+Admission control is layered: a gateway-level ``max_inflight`` gate sheds
+(429) BEFORE a request touches a queue; a full ingress maps
+``QueueFullError`` -> 429; an oversize graph maps ``BucketOverflowError``
+-> 413; a queued-deadline or hard-deadline expiry maps
+``RequestTimeoutError`` -> 504. Every error body is JSON
+(``{"error": str, "type": str}``) — a client never sees a hung socket or an
+HTML traceback.
+
+Graceful drain (the PR-3 preemption contract, at the serving edge): SIGTERM
+flips ``/readyz`` to 503 and stops admitting predicts (503), drains every
+queue via ``RequestQueue.stop(drain=True)`` so EVERY accepted request
+resolves with a real status (200/429/504), waits for in-flight handlers,
+then stops the accept loop — the process exits 0.
+
+Every request runs inside an obs span (``serve/http`` with route/status
+attrs) and lands in per-route latency reservoirs plus shed/timeout counters
+in the metrics registry (the process-global obs registry by default), so
+``GET /metrics`` is the live scrape endpoint ROADMAP's obs item asked for.
+"""
+
+from __future__ import annotations
+
+import base64
+import json
+import signal
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional, Tuple
+
+import numpy as np
+
+from distegnn_tpu import obs
+from distegnn_tpu.obs.metrics import MetricsRegistry, _prom_name
+from distegnn_tpu.serve.buckets import BucketOverflowError
+from distegnn_tpu.serve.queue import QueueFullError, RequestTimeoutError
+from distegnn_tpu.serve.registry import ModelRegistry
+
+
+class PayloadError(ValueError):
+    """Malformed request body — the transport's 400."""
+
+
+# ---- payload <-> graph dict -------------------------------------------------
+
+def decode_array(spec, dtype: str, name: str) -> np.ndarray:
+    """JSON array spec -> numpy: nested lists, or ``{"b64": <base64 of
+    little-endian raw bytes>, "shape": [...]}`` for dense payloads."""
+    if spec is None:
+        raise PayloadError(f"missing '{name}'")
+    if isinstance(spec, dict):
+        if "b64" not in spec:
+            raise PayloadError(f"'{name}': object form needs 'b64' "
+                               f"(+ optional 'shape')")
+        try:
+            raw = base64.b64decode(spec["b64"], validate=True)
+        except Exception:
+            raise PayloadError(f"'{name}': invalid base64") from None
+        try:
+            arr = np.frombuffer(raw, dtype=np.dtype(dtype))
+            shape = spec.get("shape")
+            if shape is not None:
+                arr = arr.reshape([int(s) for s in shape])
+        except Exception as exc:
+            raise PayloadError(f"'{name}': {exc}") from None
+        return arr.copy()           # frombuffer views are read-only
+    try:
+        return np.asarray(spec, dtype=np.dtype(dtype))
+    except Exception:
+        raise PayloadError(f"'{name}': not a numeric array") from None
+
+
+def encode_array(arr: np.ndarray, encoding: str):
+    if encoding == "b64":
+        a = np.ascontiguousarray(arr, dtype="<f4")
+        return {"b64": base64.b64encode(a.tobytes()).decode("ascii"),
+                "shape": list(a.shape)}
+    return np.asarray(arr, dtype=np.float64).tolist()
+
+
+def graph_from_payload(payload: dict, feat_nf: int,
+                       edge_attr_nf: int) -> dict:
+    """Validate a predict body and build the pad_graphs-style graph dict
+    the queue consumes. Required: ``positions`` [n,3] and either
+    ``edge_index`` [2,E] or a ``radius`` (server-side radius graph).
+    Optional: ``velocities`` (default zeros), ``node_feat`` (default |v|
+    replicated to the model's width), ``edge_attr`` (default pairwise
+    distances replicated)."""
+    if not isinstance(payload, dict):
+        raise PayloadError("body must be a JSON object")
+    loc = decode_array(payload.get("positions", payload.get("loc")),
+                       "<f4", "positions")
+    if loc.ndim != 2 or loc.shape[1] != 3 or loc.shape[0] < 1:
+        raise PayloadError(f"'positions' must be [n, 3] "
+                           f"(got {list(loc.shape)})")
+    n = int(loc.shape[0])
+    vel_spec = payload.get("velocities", payload.get("vel"))
+    if vel_spec is None:
+        vel = np.zeros((n, 3), np.float32)
+    else:
+        vel = decode_array(vel_spec, "<f4", "velocities")
+        if vel.shape != loc.shape:
+            raise PayloadError(f"'velocities' must match positions shape "
+                               f"(got {list(vel.shape)})")
+    ei_spec = payload.get("edge_index")
+    if ei_spec is not None:
+        ei = decode_array(ei_spec, "<i4", "edge_index")
+        if ei.ndim != 2 or ei.shape[0] != 2 or ei.shape[1] < 1:
+            raise PayloadError(f"'edge_index' must be [2, E], E >= 1 "
+                               f"(got {list(ei.shape)})")
+        if int(ei.min()) < 0 or int(ei.max()) >= n:
+            raise PayloadError("'edge_index' references nodes outside "
+                               f"[0, {n})")
+    elif payload.get("radius") is not None:
+        from distegnn_tpu.ops.radius import radius_graph_np
+
+        ei = radius_graph_np(loc, float(payload["radius"]))
+        if ei.shape[1] == 0:
+            if n < 2:
+                raise PayloadError("radius graph is empty and n < 2; "
+                                   "send 'edge_index' explicitly")
+            ei = np.array([[0, 1], [1, 0]], np.int32).T.reshape(2, 2)
+    else:
+        raise PayloadError("provide 'edge_index' or 'radius'")
+    ei = ei.astype(np.int32)
+
+    feat_spec = payload.get("node_feat")
+    if feat_spec is None:
+        feat = np.linalg.norm(vel, axis=1, keepdims=True).astype(np.float32)
+        feat = np.repeat(feat, max(feat_nf, 1), axis=1)[:, :max(feat_nf, 1)]
+    else:
+        feat = decode_array(feat_spec, "<f4", "node_feat")
+        if feat.ndim != 2 or feat.shape[0] != n or feat.shape[1] != feat_nf:
+            raise PayloadError(f"'node_feat' must be [{n}, {feat_nf}] "
+                               f"(got {list(feat.shape)})")
+    attr_spec = payload.get("edge_attr")
+    if attr_spec is None:
+        d = np.linalg.norm(loc[ei[0]] - loc[ei[1]], axis=1)[:, None]
+        attr = np.repeat(d, max(edge_attr_nf, 1),
+                         axis=1).astype(np.float32)[:, :max(edge_attr_nf, 1)]
+    else:
+        attr = decode_array(attr_spec, "<f4", "edge_attr")
+        if (attr.ndim != 2 or attr.shape[0] != ei.shape[1]
+                or attr.shape[1] != edge_attr_nf):
+            raise PayloadError(
+                f"'edge_attr' must be [{ei.shape[1]}, {edge_attr_nf}] "
+                f"(got {list(attr.shape)})")
+    return {"node_feat": feat.astype(np.float32),
+            "loc": loc.astype(np.float32), "vel": vel.astype(np.float32),
+            "target": loc.astype(np.float32), "edge_index": ei,
+            "edge_attr": attr.astype(np.float32)}
+
+
+# ---- the gateway ------------------------------------------------------------
+
+_GATEWAY_COUNTERS = (
+    "requests_total", "predict_ok", "shed_inflight", "shed_queue_full",
+    "timeouts", "bad_requests", "unknown_model", "overflow_rejected",
+    "draining_rejected", "errors",
+)
+
+
+class _Server(ThreadingHTTPServer):
+    daemon_threads = True
+    # 0.0.0.0 binds are deliberate (serve.gateway.host); rebinding a
+    # lingering TIME_WAIT port must not fail a restart
+    allow_reuse_address = True
+
+    def handle_error(self, request, client_address):
+        # socketserver's default prints a traceback to stderr; keep the
+        # event stream as the error surface instead
+        obs.event("gateway/socket_error", client=str(client_address))
+
+
+class Gateway:
+    """The HTTP front-end: routing, admission, drain, metrics.
+
+    Handler logic lives on this class (the request handler is a thin
+    dispatcher) so tests can drive pieces without sockets.
+    """
+
+    def __init__(self, registry: ModelRegistry, *, host: str = "127.0.0.1",
+                 port: int = 0, max_inflight: int = 64,
+                 drain_grace_s: float = 10.0,
+                 metrics_registry: Optional[MetricsRegistry] = None):
+        self.registry = registry
+        self.max_inflight = int(max_inflight)
+        self.drain_grace_s = float(drain_grace_s)
+        self._reg = metrics_registry or obs.get_registry()
+        self._c = {n: self._reg.counter("gateway/" + n)
+                   for n in _GATEWAY_COUNTERS}
+        self._inflight_gauge = self._reg.gauge("gateway/inflight")
+        self._ready_gauge = self._reg.gauge("gateway/ready")
+        self._inflight = 0
+        self._inflight_lock = threading.Lock()
+        self._accepting = True
+        self._draining = False
+        self._drain_lock = threading.Lock()
+        self.httpd = _Server((host, int(port)), _make_handler(self))
+
+    # ---- addresses -------------------------------------------------------
+    @property
+    def address(self) -> Tuple[str, int]:
+        host, port = self.httpd.server_address[:2]
+        return str(host), int(port)
+
+    @property
+    def port(self) -> int:
+        return self.address[1]
+
+    def url(self, path: str = "") -> str:
+        host, port = self.address
+        return f"http://{host}:{port}{path}"
+
+    # ---- lifecycle -------------------------------------------------------
+    def serve_forever(self) -> None:
+        self._ready_gauge.set(1.0 if self.ready() else 0.0)
+        self.httpd.serve_forever(poll_interval=0.1)
+
+    def install_signal_handlers(self) -> None:
+        """SIGTERM/SIGINT -> graceful drain. The handler only spawns the
+        drain thread (queue.stop joins a thread — never block the main
+        thread's serve loop from its own signal frame)."""
+        def _on_signal(signum, frame):
+            obs.event("gateway/signal", signum=int(signum))
+            threading.Thread(target=self.drain, name="gateway-drain",
+                             daemon=True).start()
+
+        signal.signal(signal.SIGTERM, _on_signal)
+        signal.signal(signal.SIGINT, _on_signal)
+
+    def drain(self) -> None:
+        """Stop accepting, flush every queue, wait for in-flight responses,
+        then stop the accept loop. Idempotent."""
+        with self._drain_lock:
+            if self._draining:
+                return
+            self._draining = True
+        self._accepting = False
+        self._ready_gauge.set(0.0)
+        obs.event("gateway/drain_begin", inflight=self._inflight)
+        self.registry.stop(drain=True)   # every admitted future resolves
+        deadline = time.monotonic() + self.drain_grace_s
+        while time.monotonic() < deadline:
+            with self._inflight_lock:
+                if self._inflight == 0:
+                    break
+            time.sleep(0.02)
+        obs.event("gateway/drain_done", inflight=self._inflight)
+        self.httpd.shutdown()
+
+    def close(self) -> None:
+        self.httpd.server_close()
+
+    def ready(self) -> bool:
+        return self._accepting and self.registry.ready()
+
+    # ---- request handling ------------------------------------------------
+    def _route_name(self, method: str, path: str) -> str:
+        if method == "POST" and path.startswith("/v1/models/") \
+                and path.endswith("/predict"):
+            return "predict"
+        return {"/v1/models": "models", "/metrics": "metrics",
+                "/healthz": "healthz", "/readyz": "readyz"}.get(path,
+                                                                "unknown")
+
+    def dispatch(self, handler, method: str) -> None:
+        path = handler.path.split("?", 1)[0]
+        route = self._route_name(method, path)
+        self._c["requests_total"].add(1)
+        t0 = time.perf_counter()
+        with obs.span("serve/http", route=route, method=method) as sp:
+            try:
+                status = self._handle(handler, method, path, route)
+            except PayloadError as exc:
+                self._c["bad_requests"].add(1)
+                status = self._send_json(handler, 400, {
+                    "error": str(exc), "type": "PayloadError"})
+            except BrokenPipeError:
+                status = 499        # client went away mid-response
+            except Exception as exc:
+                self._c["errors"].add(1)
+                obs.event("gateway/handler_error", route=route,
+                          error=repr(exc))
+                status = self._send_json(handler, 500, {
+                    "error": repr(exc), "type": type(exc).__name__})
+            sp.set(status=status)
+        self._reg.reservoir(f"gateway/http_{route}_ms").record(
+            (time.perf_counter() - t0) * 1e3)
+
+    def _handle(self, h, method: str, path: str, route: str) -> int:
+        if route == "predict":
+            if method != "POST":
+                return self._send_json(h, 405, {"error": "POST only",
+                                                "type": "MethodNotAllowed"})
+            return self._predict(h, path)
+        if method != "GET":
+            return self._send_json(h, 405, {"error": "GET only",
+                                            "type": "MethodNotAllowed"})
+        if route == "healthz":
+            return self._send_json(h, 200, {"status": "ok"})
+        if route == "readyz":
+            self._ready_gauge.set(1.0 if self.ready() else 0.0)
+            if self.ready():
+                return self._send_json(h, 200, {"ready": True})
+            reason = ("draining" if not self._accepting else
+                      "models not warmed or dispatcher down")
+            return self._send_json(h, 503, {"ready": False,
+                                            "reason": reason})
+        if route == "metrics":
+            return self._send_text(h, 200, self.render_metrics(),
+                                   content_type="text/plain; version=0.0.4")
+        if route == "models":
+            return self._send_json(h, 200, self.registry.describe())
+        return self._send_json(h, 404, {"error": f"no route {path}",
+                                        "type": "NotFound"})
+
+    def _predict(self, h, path: str) -> int:
+        name = path[len("/v1/models/"):-len("/predict")]
+        if not self._try_acquire():
+            self._c["shed_inflight"].add(1)
+            return self._send_json(h, 429, {
+                "error": f"gateway at max_inflight={self.max_inflight}; "
+                         "retry with backoff", "type": "Overloaded"})
+        try:
+            return self._predict_admitted(h, name)
+        finally:
+            self._release()
+
+    def _predict_admitted(self, h, name: str) -> int:
+        if not self._accepting:
+            self._c["draining_rejected"].add(1)
+            return self._send_json(h, 503, {
+                "error": "gateway draining", "type": "Draining"})
+        try:
+            entry = self.registry.get(name)
+        except KeyError:
+            self._c["unknown_model"].add(1)
+            return self._send_json(h, 404, {
+                "error": f"unknown model {name!r}; "
+                         f"see GET /v1/models", "type": "UnknownModel"})
+        payload = self._read_json(h)
+        graph = graph_from_payload(payload, entry.feat_nf,
+                                   entry.edge_attr_nf)
+        encoding = str(payload.get("encoding", "list"))
+        if encoding not in ("list", "b64"):
+            raise PayloadError("'encoding' must be 'list' or 'b64'")
+        t0 = time.perf_counter()
+        try:
+            fut = entry.queue.submit(graph)
+        except QueueFullError as exc:
+            self._c["shed_queue_full"].add(1)
+            return self._send_json(h, 429, {"error": str(exc),
+                                            "type": "QueueFull"})
+        except BucketOverflowError as exc:
+            self._c["overflow_rejected"].add(1)
+            return self._send_json(h, 413, {"error": str(exc),
+                                            "type": "BucketOverflow"})
+        except RuntimeError as exc:       # queue stopped under our feet
+            self._c["draining_rejected"].add(1)
+            return self._send_json(h, 503, {"error": str(exc),
+                                            "type": "Draining"})
+        try:
+            out = fut.result()            # bounded by the hard deadline
+        except RequestTimeoutError as exc:
+            self._c["timeouts"].add(1)
+            return self._send_json(h, 504, {"error": str(exc),
+                                            "type": "RequestTimeout"})
+        meta = dict(fut.meta)
+        self._c["predict_ok"].add(1)
+        return self._send_json(h, 200, {
+            "model": name,
+            "n": int(graph["loc"].shape[0]),
+            "prediction": encode_array(out, encoding),
+            "bucket": {"n": meta.get("bucket_n"), "e": meta.get("bucket_e")},
+            "queue_ms": meta.get("queue_ms"),
+            "compute_ms": meta.get("compute_ms"),
+            "batch_filled": meta.get("batch_filled"),
+            "total_ms": round((time.perf_counter() - t0) * 1e3, 3),
+        })
+
+    # ---- metrics ---------------------------------------------------------
+    def render_metrics(self) -> str:
+        """Prometheus text: the gateway/process-wide registry, then each
+        model's serve registry under a per-model name prefix (distinct
+        names instead of labels — the renderer is label-free)."""
+        with self._inflight_lock:
+            self._inflight_gauge.set(self._inflight)
+        self._ready_gauge.set(1.0 if self.ready() else 0.0)
+        parts = [self._reg.render_prometheus(prefix="distegnn")]
+        for name, entry in self.registry.items():
+            parts.append(entry.engine.metrics.registry.render_prometheus(
+                prefix=_prom_name(f"distegnn_model_{name}")))
+        return "".join(parts)
+
+    # ---- plumbing --------------------------------------------------------
+    def _try_acquire(self) -> bool:
+        with self._inflight_lock:
+            if self._inflight >= self.max_inflight:
+                return False
+            self._inflight += 1
+            return True
+
+    def _release(self) -> None:
+        with self._inflight_lock:
+            self._inflight -= 1
+
+    @staticmethod
+    def _read_json(h) -> dict:
+        try:
+            length = int(h.headers.get("Content-Length", 0))
+        except (TypeError, ValueError):
+            raise PayloadError("bad Content-Length") from None
+        if length <= 0:
+            raise PayloadError("empty body (Content-Length required)")
+        body = h.rfile.read(length)
+        try:
+            return json.loads(body)
+        except json.JSONDecodeError as exc:
+            raise PayloadError(f"invalid JSON: {exc}") from None
+
+    @staticmethod
+    def _send_text(h, status: int, text: str,
+                   content_type: str = "text/plain") -> int:
+        body = text.encode("utf-8")
+        h.send_response(status)
+        h.send_header("Content-Type", content_type)
+        h.send_header("Content-Length", str(len(body)))
+        h.end_headers()
+        h.wfile.write(body)
+        return status
+
+    @classmethod
+    def _send_json(cls, h, status: int, obj) -> int:
+        return cls._send_text(h, status, json.dumps(obj),
+                              content_type="application/json")
+
+
+def _make_handler(gateway: Gateway):
+    class Handler(BaseHTTPRequestHandler):
+        protocol_version = "HTTP/1.1"
+        server_version = "distegnn-gateway"
+
+        def log_message(self, format, *args):
+            pass    # access logging is the serve/http span, not stderr
+
+        def do_GET(self):
+            gateway.dispatch(self, "GET")
+
+        def do_POST(self):
+            gateway.dispatch(self, "POST")
+
+    return Handler

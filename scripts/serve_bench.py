@@ -13,6 +13,12 @@ CPU works (JAX_PLATFORMS=cpu); the same harness runs unchanged on TPU.
   python scripts/serve_bench.py --config_path configs/nbody_serve.yaml \
       --requests 64 --rate 200 --sizes 48,96,192
 
+``--transport http`` runs the SAME open loop through a real socket: an
+in-process HTTP gateway (serve/transport.py) on an ephemeral port, each
+arrival a POST /v1/models/bench/predict from a client thread (base64 f32
+payloads), so the BENCH line includes JSON+HTTP+routing overhead — the
+number a network client actually sees. Stdout stays exactly one line.
+
 Obs: the run's structured event stream (serve/batch, serve/execute,
 jax/compile, ...) lands at --obs-dir/obs/events.jsonl (default
 logs/serve_bench/, gitignored) so hw_session.sh can archive it next to the
@@ -50,6 +56,83 @@ def _build(cfg, sizes, seed):
     return engine, q, graphs
 
 
+def _b64_field(a, dtype):
+    import base64
+
+    import numpy as np
+
+    a = np.ascontiguousarray(a, dtype=dtype)
+    return {"b64": base64.b64encode(a.tobytes()).decode("ascii"),
+            "shape": list(a.shape)}
+
+
+def _http_payload(g) -> bytes:
+    return json.dumps({
+        "positions": _b64_field(g["loc"], "<f4"),
+        "velocities": _b64_field(g["vel"], "<f4"),
+        "node_feat": _b64_field(g["node_feat"], "<f4"),
+        "edge_attr": _b64_field(g["edge_attr"], "<f4"),
+        "edge_index": _b64_field(g["edge_index"], "<i4"),
+        "encoding": "b64",
+    }).encode()
+
+
+def _run_http(engine, q, graphs, requests, rate):
+    """The same open loop, but every arrival is a POST through a live
+    in-process gateway socket. Returns (wall_s, rejected_429, statuses)."""
+    import threading
+    import urllib.error
+    import urllib.request
+
+    from distegnn_tpu.serve.registry import ModelRegistry
+    from distegnn_tpu.serve.transport import Gateway
+
+    q.start()
+    registry = ModelRegistry.single(
+        "bench", engine, q, feat_nf=graphs[0]["node_feat"].shape[1],
+        edge_attr_nf=graphs[0]["edge_attr"].shape[1])
+    gw = Gateway(registry, port=0, max_inflight=max(64, requests))
+    server = threading.Thread(target=gw.serve_forever,
+                              name="bench-gateway", daemon=True)
+    server.start()
+    url = gw.url("/v1/models/bench/predict")
+    payloads = [_http_payload(g) for g in graphs]
+    statuses = [0] * requests
+
+    def post(i, body):
+        req = urllib.request.Request(
+            url, data=body, headers={"Content-Type": "application/json"},
+            method="POST")
+        try:
+            with urllib.request.urlopen(req, timeout=120.0) as resp:
+                statuses[i] = int(resp.status)
+        except urllib.error.HTTPError as e:
+            statuses[i] = int(e.code)
+        except Exception:
+            statuses[i] = -1
+
+    threads = []
+    t0 = time.perf_counter()
+    for k in range(requests):
+        target = t0 + k / rate
+        delay = target - time.perf_counter()
+        if delay > 0:
+            time.sleep(delay)
+        t = threading.Thread(target=post,
+                             args=(k, payloads[k % len(payloads)]),
+                             daemon=True)
+        t.start()
+        threads.append(t)
+    for t in threads:
+        t.join(timeout=180.0)
+    wall = time.perf_counter() - t0
+    gw.drain()               # also stops the queue (drain=True)
+    server.join(timeout=30.0)
+    gw.close()
+    rejected = sum(1 for s in statuses if s == 429)
+    return wall, rejected, statuses
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description="serve-stack open-loop bench")
     ap.add_argument("--config_path", type=str, default=None,
@@ -65,6 +148,10 @@ def main(argv=None) -> int:
     ap.add_argument("--obs-dir", type=str, default="logs/serve_bench",
                     help="event-stream sink dir (events land at <dir>/obs/"
                          "events.jsonl); '' disables tracing")
+    ap.add_argument("--transport", choices=("inproc", "http"),
+                    default="inproc",
+                    help="inproc = RequestQueue.submit directly; http = "
+                         "through a live gateway socket (serve/transport.py)")
     args = ap.parse_args(argv)
 
     from distegnn_tpu import obs
@@ -85,26 +172,31 @@ def main(argv=None) -> int:
     # compiles past this point are regressions obs_report --check flags
     jaxprobe.mark_warmup_done()
     obs.event("serve/bench_start", requests=args.requests, rate=args.rate,
-              sizes=sizes, warmup=not args.no_warmup)
+              sizes=sizes, warmup=not args.no_warmup,
+              transport=args.transport)
 
-    futures, rejected = [], 0
-    t0 = time.perf_counter()
-    with q:
-        for k in range(args.requests):
-            target = t0 + k / args.rate
-            delay = target - time.perf_counter()
-            if delay > 0:
-                time.sleep(delay)
-            try:
-                futures.append(q.submit(graphs[k % len(graphs)]))
-            except Exception:  # QueueFullError: open loop sheds, keeps going
-                rejected += 1
-        for f in futures:
-            try:
-                f.result(timeout=60.0)
-            except Exception:
-                pass  # failures are visible in the snapshot counters
-    wall = time.perf_counter() - t0
+    if args.transport == "http":
+        wall, rejected, _statuses = _run_http(engine, q, graphs,
+                                              args.requests, args.rate)
+    else:
+        futures, rejected = [], 0
+        t0 = time.perf_counter()
+        with q:
+            for k in range(args.requests):
+                target = t0 + k / args.rate
+                delay = target - time.perf_counter()
+                if delay > 0:
+                    time.sleep(delay)
+                try:
+                    futures.append(q.submit(graphs[k % len(graphs)]))
+                except Exception:  # QueueFullError: open loop sheds
+                    rejected += 1
+            for f in futures:
+                try:
+                    f.result(timeout=60.0)
+                except Exception:
+                    pass  # failures are visible in the snapshot counters
+        wall = time.perf_counter() - t0
 
     snap = engine.metrics.snapshot()
     completed = snap["requests_completed"]
@@ -117,6 +209,7 @@ def main(argv=None) -> int:
         "rejected_at_submit": rejected,
         "offered_rate": args.rate,
         "sizes": sizes,
+        "transport": args.transport,
         "wall_s": round(wall, 4),
         "platform": __import__("jax").default_backend(),
         "snapshot": snap,

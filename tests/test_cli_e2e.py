@@ -72,6 +72,92 @@ def test_water3d_cutoff_yaml_runs_via_main(tmp_path):
     _assert_run_artifacts(log_dir)
 
 
+def test_gateway_smoke_drill(tmp_path):
+    """Tier-1 serving-edge drill (the SIGTERM mirror of the preempt drill):
+    boot scripts/serve_gateway.py as a REAL process on an ephemeral port,
+    predict against a warmed rung, scrape /metrics, SIGTERM it, and assert
+    exit 0 with an obs stream that passes obs_report --check (telemetry
+    alive, zero steady-state recompiles)."""
+    import json
+    import re
+    import signal
+    import sys
+    import threading
+    import time
+    import urllib.request
+
+    with open(os.path.join(CONFIG_DIR, "nbody_serve.yaml")) as f:
+        cfg = yaml.safe_load(f)
+    # shrink the model so boot+warmup stays in CPU smoke-test territory
+    cfg["model"].update(hidden_nf=16, n_layers=2, virtual_channels=2)
+    cfg_path = str(tmp_path / "gateway.yaml")
+    with open(cfg_path, "w") as f:
+        yaml.safe_dump(cfg, f)
+
+    env = dict(os.environ, PYTHONPATH=REPO_DIR, JAX_PLATFORMS="cpu")
+    obs_dir = str(tmp_path / "gwobs")
+    proc = subprocess.Popen(
+        [sys.executable, os.path.join(REPO_DIR, "scripts", "serve_gateway.py"),
+         "--config_path", cfg_path, "--port", "0", "--warmup-nodes", "16",
+         "--obs-dir", obs_dir],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+        env=env, cwd=REPO_DIR)
+    lines = []
+    reader = threading.Thread(
+        target=lambda: [lines.append(ln) for ln in proc.stdout], daemon=True)
+    reader.start()
+    try:
+        # the gateway prints its bound (ephemeral) port in the listening line
+        deadline = time.monotonic() + 240.0
+        port = None
+        while time.monotonic() < deadline and port is None:
+            for ln in list(lines):
+                m = re.search(r"listening on http://[\d.]+:(\d+)", ln)
+                if m:
+                    port = int(m.group(1))
+            if proc.poll() is not None:
+                raise AssertionError("gateway died: " + "".join(lines))
+            time.sleep(0.1)
+        assert port, "no listening line: " + "".join(lines)
+        base = f"http://127.0.0.1:{port}"
+
+        with urllib.request.urlopen(base + "/readyz", timeout=30) as r:
+            assert r.status == 200
+        # n=16 == --warmup-nodes: lands on an already-compiled rung, so the
+        # obs stream stays free of steady-state recompiles for --check
+        from distegnn_tpu.serve import synthetic_graph
+        g = synthetic_graph(16, seed=0)
+        req = urllib.request.Request(
+            base + "/v1/models/default/predict",
+            data=json.dumps({"positions": g["loc"].tolist(),
+                             "velocities": g["vel"].tolist(),
+                             "edge_index": g["edge_index"].tolist()}).encode(),
+            headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=120) as r:
+            resp = json.load(r)
+        assert np.asarray(resp["prediction"]).shape == (16, 3)
+        with urllib.request.urlopen(base + "/metrics", timeout=30) as r:
+            metrics = r.read().decode()
+        assert "distegnn_gateway_requests_total" in metrics
+        assert "distegnn_model_default_serve_requests_completed" in metrics
+
+        proc.send_signal(signal.SIGTERM)      # graceful drain -> exit 0
+        assert proc.wait(timeout=120) == 0, "".join(lines)
+        reader.join(timeout=10)
+        assert any("drained and stopped" in ln for ln in lines)
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+            proc.wait(timeout=30)
+
+    events = os.path.join(obs_dir, "obs", "events.jsonl")
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO_DIR, "scripts", "obs_report.py"),
+         events, "--check"],
+        capture_output=True, text=True, env=env, cwd=REPO_DIR, timeout=120)
+    assert r.returncode == 0, r.stdout + r.stderr
+
+
 def test_preempt_drill_fast(tmp_path):
     """Tier-1 preemption drill (docs/ROBUSTNESS.md): scripts/preempt_drill.sh
     --fast runs control → deterministic SIGTERM victim (expects exit 75 +

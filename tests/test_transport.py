@@ -1,0 +1,509 @@
+"""HTTP transport front-end (distegnn_tpu/serve/transport.py + registry.py):
+predict parity over a REAL socket (ephemeral port), multi-model routing,
+layered admission control (429/413/504), /metrics Prometheus scrape,
+readiness across warmup and drain, and the queue's stop/hard-deadline
+hardening — all CPU, in-process server threads."""
+
+import base64
+import json
+import re
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import jax
+import numpy as np
+import pytest
+
+from distegnn_tpu.models.fast_egnn import FastEGNN
+from distegnn_tpu.obs.metrics import MetricsRegistry
+from distegnn_tpu.ops.graph import pad_graphs
+from distegnn_tpu.serve import (BucketLadder, InferenceEngine, RequestQueue,
+                                RequestTimeoutError, ServeMetrics,
+                                synthetic_graph)
+from distegnn_tpu.serve.registry import ModelRegistry
+from distegnn_tpu.serve.transport import (Gateway, PayloadError,
+                                          graph_from_payload)
+
+pytestmark = pytest.mark.serve
+
+
+def _model():
+    return FastEGNN(node_feat_nf=1, edge_attr_nf=2, hidden_nf=16,
+                    virtual_channels=2, n_layers=2)
+
+
+def _init(model, graph):
+    tight = pad_graphs([graph], node_bucket=1, edge_bucket=1)
+    return model.init(jax.random.PRNGKey(0), tight)
+
+
+def _reference(model, params, graph):
+    tight = pad_graphs([graph], node_bucket=1, edge_bucket=1)
+    x, _ = model.apply(params, tight)
+    return np.asarray(x[0])
+
+
+def _get(url, timeout=30.0):
+    """GET returning (status, parsed-or-text body) without raising on 4xx."""
+    try:
+        with urllib.request.urlopen(url, timeout=timeout) as r:
+            body = r.read().decode()
+            status = r.status
+    except urllib.error.HTTPError as e:
+        body = e.read().decode()
+        status = e.code
+    try:
+        return status, json.loads(body)
+    except json.JSONDecodeError:
+        return status, body
+
+
+def _post(url, payload, timeout=60.0):
+    req = urllib.request.Request(
+        url, data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"}, method="POST")
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as r:
+            return r.status, json.load(r)
+    except urllib.error.HTTPError as e:
+        return e.code, json.load(e)
+
+
+def _payload(g, encoding="list"):
+    if encoding == "b64":
+        def f32(a):
+            a = np.ascontiguousarray(a, dtype="<f4")
+            return {"b64": base64.b64encode(a.tobytes()).decode(),
+                    "shape": list(a.shape)}
+
+        ei = np.ascontiguousarray(g["edge_index"], dtype="<i4")
+        return {"positions": f32(g["loc"]), "velocities": f32(g["vel"]),
+                "node_feat": f32(g["node_feat"]),
+                "edge_attr": f32(g["edge_attr"]),
+                "edge_index": {"b64": base64.b64encode(ei.tobytes()).decode(),
+                               "shape": list(ei.shape)},
+                "encoding": "b64"}
+    return {"positions": g["loc"].tolist(), "velocities": g["vel"].tolist(),
+            "node_feat": g["node_feat"].tolist(),
+            "edge_index": g["edge_index"].tolist(),
+            "edge_attr": g["edge_attr"].tolist()}
+
+
+class _Live:
+    """One warmed single-model gateway on an ephemeral port (shared by the
+    read-mostly tests; admission/drain tests build their own)."""
+
+    def __init__(self):
+        self.model = _model()
+        self.graph = synthetic_graph(28, seed=3)
+        self.params = _init(self.model, self.graph)
+        self.metrics = ServeMetrics()
+        self.engine = InferenceEngine(self.model, self.params, max_batch=4,
+                                      metrics=self.metrics)
+        self.queue = RequestQueue(self.engine, batch_deadline_ms=30.0,
+                                  queue_capacity=64,
+                                  request_timeout_ms=60_000.0,
+                                  metrics=self.metrics)
+        self.registry = ModelRegistry.single("nbody", self.engine, self.queue,
+                                             feat_nf=1, edge_attr_nf=2)
+        self.registry.start()
+        self.registry.warmup([28])
+        self.gw = Gateway(self.registry, port=0, max_inflight=32,
+                          metrics_registry=MetricsRegistry())
+        self.thread = threading.Thread(target=self.gw.serve_forever,
+                                       daemon=True)
+        self.thread.start()
+        self.url = self.gw.url
+
+    def close(self):
+        self.gw.drain()
+        self.thread.join(timeout=30.0)
+        self.gw.close()
+
+
+@pytest.fixture(scope="module")
+def live():
+    env = _Live()
+    yield env
+    env.close()
+
+
+# ------------------------------------------------------------- predict API
+
+@pytest.mark.parametrize("encoding", ["list", "b64"])
+def test_predict_parity_over_socket(live, encoding):
+    """The tentpole acceptance: a socket round-trip returns the SAME numbers
+    as direct model.apply on the unpadded graph, plus timing/bucket meta."""
+    status, resp = _post(live.url("/v1/models/nbody/predict"),
+                         _payload(live.graph, encoding))
+    assert status == 200
+    if encoding == "b64":
+        raw = base64.b64decode(resp["prediction"]["b64"])
+        pred = np.frombuffer(raw, "<f4").reshape(resp["prediction"]["shape"])
+    else:
+        pred = np.asarray(resp["prediction"], np.float32)
+    ref = _reference(live.model, live.params, live.graph)
+    np.testing.assert_allclose(pred, ref, atol=1e-4, rtol=0)
+    assert resp["model"] == "nbody" and resp["n"] == 28
+    assert resp["bucket"]["n"] >= 28 and resp["bucket"]["e"] >= 1
+    assert resp["queue_ms"] >= 0 and resp["compute_ms"] > 0
+    assert 1 <= resp["batch_filled"] <= 4
+    assert resp["total_ms"] >= resp["compute_ms"]
+
+
+def test_predict_server_side_radius_graph(live):
+    """positions + radius only: the gateway builds the radius graph and
+    defaults node_feat/edge_attr — the minimal client contract."""
+    status, resp = _post(live.url("/v1/models/nbody/predict"),
+                         {"positions": live.graph["loc"].tolist(),
+                          "radius": 0.8})
+    assert status == 200
+    assert np.asarray(resp["prediction"]).shape == (28, 3)
+
+
+def test_unknown_model_404(live):
+    status, resp = _post(live.url("/v1/models/nope/predict"),
+                         _payload(live.graph))
+    assert status == 404 and resp["type"] == "UnknownModel"
+
+
+@pytest.mark.parametrize("body", [
+    {},                                                       # no positions
+    {"positions": [[0.0, 0.0], [1.0, 1.0]]},                  # not [n, 3]
+    {"positions": [[0, 0, 0], [1, 1, 1]],
+     "edge_index": [[0, 5], [1, 0]]},                         # node 5 of 2
+    {"positions": [[0, 0, 0], [1, 1, 1]],
+     "edge_index": [[0], [1]],
+     "velocities": [[0, 0, 0]]},                              # vel shape
+    {"positions": {"b64": "!!!not-base64!!!"}},               # bad b64
+])
+def test_bad_payloads_400(live, body):
+    status, resp = _post(live.url("/v1/models/nbody/predict"), body)
+    assert status == 400 and resp["type"] == "PayloadError"
+
+
+def test_oversize_graph_413():
+    """A graph beyond the ladder caps is rejected at admission with 413,
+    not a 500 — the overflow contract crosses the transport intact."""
+    model = _model()
+    g = synthetic_graph(24, seed=4)
+    eng = InferenceEngine(model, _init(model, g), max_batch=2,
+                          ladder=BucketLadder(max_nodes=64, max_edges=4096))
+    q = RequestQueue(eng, request_timeout_ms=5_000.0)
+    reg = ModelRegistry.single("tiny", eng, q)
+    reg.start()
+    gw = Gateway(reg, port=0, metrics_registry=MetricsRegistry())
+    t = threading.Thread(target=gw.serve_forever, daemon=True)
+    t.start()
+    try:
+        big = synthetic_graph(200, seed=5)
+        status, resp = _post(gw.url("/v1/models/tiny/predict"), _payload(big))
+        assert status == 413 and resp["type"] == "BucketOverflow"
+    finally:
+        gw.drain()
+        t.join(timeout=30.0)
+        gw.close()
+
+
+# --------------------------------------------------------- operational API
+
+def test_healthz_models_and_unknown_route(live):
+    assert _get(live.url("/healthz"))[0] == 200
+    status, listing = _get(live.url("/v1/models"))
+    assert status == 200
+    (m,) = listing["models"]
+    assert m["name"] == "nbody" and m["state"] == "ready"
+    assert m["dispatcher_alive"] and m["warmed_rungs"]
+    assert _get(live.url("/no/such/route"))[0] == 404
+
+
+def test_metrics_prometheus_parses_with_gateway_series(live):
+    _post(live.url("/v1/models/nbody/predict"), _payload(live.graph))
+    status, text = _get(live.url("/metrics"))
+    assert status == 200
+    names = {}
+    for line in text.splitlines():
+        if not line or line.startswith("#"):
+            continue
+        # well-formed exposition: name, optional {labels}, float value
+        m = re.fullmatch(
+            r"([a-zA-Z_:][a-zA-Z0-9_:]*)(\{[^{}]*\})? (\S+)", line)
+        assert m, f"unparseable metrics line: {line!r}"
+        names[m.group(1)] = float(m.group(3))
+    assert names["distegnn_gateway_requests_total"] >= 1
+    assert names["distegnn_gateway_predict_ok"] >= 1
+    assert "distegnn_gateway_inflight" in names
+    assert names["distegnn_gateway_ready"] == 1.0
+    # per-model serve series render under a per-model name prefix
+    assert names["distegnn_model_nbody_serve_requests_completed"] >= 1
+    assert any(n.startswith("distegnn_gateway_http_predict_ms") for n in names)
+
+
+def test_concurrent_clients_share_micro_batches(live):
+    """Co-arriving same-bucket requests from independent sockets coalesce
+    into shared micro-batches — the whole point of the serving stack."""
+    n_req, results = 12, [None] * 12
+    barrier = threading.Barrier(n_req)
+
+    def post(i):
+        barrier.wait()
+        results[i] = _post(live.url("/v1/models/nbody/predict"),
+                           _payload(live.graph))
+
+    threads = [threading.Thread(target=post, args=(i,)) for i in range(n_req)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=120.0)
+    assert all(r is not None and r[0] == 200 for r in results)
+    fills = [r[1]["batch_filled"] for r in results]
+    assert max(fills) > 1, f"no micro-batch formed (fills={fills})"
+    ref = _reference(live.model, live.params, live.graph)
+    for _, resp in results:
+        np.testing.assert_allclose(np.asarray(resp["prediction"]), ref,
+                                   atol=1e-4, rtol=0)
+
+
+# -------------------------------------------------------- admission control
+
+def test_gateway_sheds_at_max_inflight(live):
+    """max_inflight=0 sheds EVERY predict with 429 before touching a queue
+    (operational endpoints stay up — shedding is for compute only)."""
+    gw = Gateway(live.registry, port=0, max_inflight=0,
+                 metrics_registry=MetricsRegistry())
+    t = threading.Thread(target=gw.serve_forever, daemon=True)
+    t.start()
+    try:
+        status, resp = _post(gw.url("/v1/models/nbody/predict"),
+                             _payload(live.graph))
+        assert status == 429 and resp["type"] == "Overloaded"
+        assert _get(gw.url("/healthz"))[0] == 200
+    finally:
+        # don't drain: that would stop the module fixture's shared queue
+        gw._accepting = False
+        gw.httpd.shutdown()
+        t.join(timeout=30.0)
+        gw.close()
+
+
+def test_queue_full_429_and_wedged_dispatcher_504():
+    """A wedged dispatcher (started flag, no thread): capacity-1 ingress
+    429s the second request, while the first one's no-timeout result() is
+    bounded by the hard deadline and surfaces as 504 — never a hung socket."""
+    model = _model()
+    g = synthetic_graph(20, seed=6)
+    eng = InferenceEngine(model, _init(model, g), max_batch=2)
+    q = RequestQueue(eng, queue_capacity=1, request_timeout_ms=150.0,
+                     result_margin_s=0.4)
+    q._started = True            # no dispatcher: nothing ever drains
+    reg = ModelRegistry.single("wedged", eng, q)
+    gw = Gateway(reg, port=0, metrics_registry=MetricsRegistry())
+    t = threading.Thread(target=gw.serve_forever, daemon=True)
+    t.start()
+    try:
+        first = {}
+
+        def slow_post():
+            first["resp"] = _post(gw.url("/v1/models/wedged/predict"),
+                                  _payload(g), timeout=30.0)
+
+        th = threading.Thread(target=slow_post)
+        th.start()
+        deadline = time.monotonic() + 5.0
+        while q.depth() < 1 and time.monotonic() < deadline:
+            time.sleep(0.01)     # wait for request 1 to occupy the ingress
+        status, resp = _post(gw.url("/v1/models/wedged/predict"), _payload(g))
+        assert status == 429 and resp["type"] == "QueueFull"
+        th.join(timeout=30.0)
+        assert first["resp"][0] == 504
+        assert first["resp"][1]["type"] == "RequestTimeout"
+    finally:
+        q._started = False
+        gw._accepting = False
+        gw.httpd.shutdown()
+        t.join(timeout=30.0)
+        gw.close()
+
+
+# ------------------------------------------------------------ ready + drain
+
+def test_readyz_flips_across_warmup_and_drain():
+    """/readyz: 503 cold -> 200 warmed -> 503 while draining (and predicts
+    get 503 Draining, not a hang); after drain the dispatcher is down."""
+    model = _model()
+    g = synthetic_graph(20, seed=7)
+    eng = InferenceEngine(model, _init(model, g), max_batch=2)
+    q = RequestQueue(eng, request_timeout_ms=10_000.0)
+    reg = ModelRegistry.single("m", eng, q)
+    gw = Gateway(reg, port=0, metrics_registry=MetricsRegistry())
+    t = threading.Thread(target=gw.serve_forever, daemon=True)
+    t.start()
+    entered, release = threading.Event(), threading.Event()
+    try:
+        status, resp = _get(gw.url("/readyz"))
+        assert status == 503 and resp["ready"] is False   # cold, not started
+
+        reg.start()
+        reg.warmup([20])
+        assert _get(gw.url("/readyz"))[0] == 200
+
+        # hold the drain open mid-flight so the 503 window is observable
+        orig_stop = reg.stop
+
+        def held_stop(drain=True):
+            entered.set()
+            release.wait(timeout=10.0)
+            orig_stop(drain=drain)
+
+        reg.stop = held_stop
+        drainer = threading.Thread(target=gw.drain, daemon=True)
+        drainer.start()
+        assert entered.wait(timeout=10.0)
+        status, resp = _get(gw.url("/readyz"))
+        assert status == 503 and resp["reason"] == "draining"
+        status, resp = _post(gw.url("/v1/models/m/predict"), _payload(g))
+        assert status == 503 and resp["type"] == "Draining"
+        release.set()
+        drainer.join(timeout=30.0)
+        t.join(timeout=30.0)
+        assert not t.is_alive()        # accept loop exited after the drain
+        assert not q.alive() and not gw.ready()
+        gw.drain()                     # idempotent: second drain is a no-op
+    finally:
+        release.set()
+        gw.close()
+
+
+# ----------------------------------------------- queue hardening satellites
+
+def test_queue_stop_idempotent_and_signal_safe():
+    """stop() never raises or deadlocks: before start, double, concurrent
+    (the SIGTERM drain racing a with-block exit), and across a restart."""
+    model = _model()
+    g = synthetic_graph(20, seed=8)
+    eng = InferenceEngine(model, _init(model, g), max_batch=2)
+    q = RequestQueue(eng, request_timeout_ms=10_000.0)
+    q.stop()                     # stop before start: no-op
+    q.stop(drain=False)
+
+    q.start()
+    fut = q.submit(g)
+    assert fut.result(timeout=60.0).shape == (20, 3)
+    stoppers = [threading.Thread(target=q.stop) for _ in range(4)]
+    for t in stoppers:
+        t.start()
+    for t in stoppers:
+        t.join(timeout=30.0)
+    assert not any(t.is_alive() for t in stoppers)
+    assert not q.alive()
+    with pytest.raises(RuntimeError):
+        q.submit(g)              # stopped queue rejects, never silently drops
+
+    q.start()                    # restartable after a full stop
+    assert q.submit(g).result(timeout=60.0).shape == (20, 3)
+    q.stop()
+    q.stop()
+
+
+def test_future_hard_deadline_bounds_blocking_result():
+    """A no-timeout result() on a wedged queue raises the typed timeout at
+    request_timeout + result_margin — the gateway's 504, not a hang."""
+    model = _model()
+    g = synthetic_graph(20, seed=9)
+    eng = InferenceEngine(model, _init(model, g), max_batch=2)
+    q = RequestQueue(eng, request_timeout_ms=100.0, result_margin_s=0.3)
+    q._started = True            # wedged: no dispatcher will ever resolve it
+    fut = q.submit(g)
+    t0 = time.monotonic()
+    with pytest.raises(RequestTimeoutError):
+        fut.result()             # NO timeout arg: the hard deadline bounds it
+    assert time.monotonic() - t0 < 5.0
+    q._started = False
+    q._fail_all(RequestTimeoutError("cleanup"))
+
+
+# ----------------------------------------------------------- payload parsing
+
+def test_graph_from_payload_defaults_and_validation():
+    g = synthetic_graph(10, seed=10)
+    out = graph_from_payload({"positions": g["loc"].tolist(),
+                              "edge_index": g["edge_index"].tolist()},
+                             feat_nf=1, edge_attr_nf=2)
+    assert out["loc"].shape == (10, 3) and out["vel"].shape == (10, 3)
+    assert out["node_feat"].shape == (10, 1)
+    assert out["edge_attr"].shape == (g["edge_index"].shape[1], 2)
+    assert out["edge_index"].dtype == np.int32
+    with pytest.raises(PayloadError):
+        graph_from_payload({"positions": g["loc"].tolist()}, 1, 2)  # no edges
+    with pytest.raises(PayloadError):
+        graph_from_payload({"positions": g["loc"].tolist(),
+                            "edge_index": g["edge_index"].tolist(),
+                            "node_feat": [[1.0]] * 3}, 1, 2)  # wrong n
+
+
+# ------------------------------------------------------- multi-model config
+
+def test_registry_from_config_multi_model_routing():
+    """serve.models: two independently-overridden models behind one gateway,
+    each owning its engine/queue/warmup; /v1/models lists both and predicts
+    route to DIFFERENT weights (the responses must differ)."""
+    from distegnn_tpu.config import ConfigDict, _DEFAULTS
+
+    cfg = ConfigDict(_DEFAULTS)
+    cfg.model.update(model_name="FastEGNN", hidden_nf=16, n_layers=2,
+                     virtual_channels=2, node_feat_nf=1, edge_attr_nf=2)
+    cfg.serve.models = [
+        {"name": "a"},
+        {"name": "b", "overrides": {"model": {"hidden_nf": 8}, "seed": 7}},
+    ]
+    reg = ModelRegistry.from_config(cfg)
+    assert reg.names() == ["a", "b"]
+    assert reg.get("b").config.model.hidden_nf == 8
+    reg.start()
+    reg.warmup([20])
+    assert reg.ready()
+    gw = Gateway(reg, port=0, metrics_registry=MetricsRegistry())
+    t = threading.Thread(target=gw.serve_forever, daemon=True)
+    t.start()
+    try:
+        status, listing = _get(gw.url("/v1/models"))
+        assert status == 200
+        assert [m["name"] for m in listing["models"]] == ["a", "b"]
+        assert all(m["state"] == "ready" for m in listing["models"])
+        g = synthetic_graph(20, seed=12)
+        preds = {}
+        for name in ("a", "b"):
+            status, resp = _post(gw.url(f"/v1/models/{name}/predict"),
+                                 _payload(g))
+            assert status == 200 and resp["model"] == name
+            preds[name] = np.asarray(resp["prediction"])
+        # different widths + seeds: same input, different weights
+        assert not np.allclose(preds["a"], preds["b"])
+        _, text = _get(gw.url("/metrics"))
+        assert "distegnn_model_a_serve_requests_completed" in text
+        assert "distegnn_model_b_serve_requests_completed" in text
+    finally:
+        gw.drain()
+        t.join(timeout=30.0)
+        gw.close()
+
+
+# ------------------------------------------------------------------- bench
+
+def test_serve_bench_http_transport_one_json_line(capsys):
+    """--transport http: the SAME open loop through a real socket still
+    emits exactly one BENCH JSON line on stdout."""
+    from scripts.serve_bench import main as bench_main
+
+    rc = bench_main(["--requests", "8", "--rate", "500", "--sizes", "24",
+                     "--seed", "11", "--transport", "http", "--obs-dir", ""])
+    assert rc == 0
+    lines = [ln for ln in capsys.readouterr().out.strip().splitlines() if ln]
+    assert len(lines) == 1
+    rec = json.loads(lines[0])
+    assert rec["metric"] == "serve_throughput"
+    assert rec["transport"] == "http"
+    assert rec["value"] > 0
+    assert rec["snapshot"]["requests_completed"] == 8

@@ -197,6 +197,11 @@ _DEFAULTS: dict = {
         # optional K-step rollout serving (rollout.make_rollout_fn kwargs);
         # null disables the rollout endpoint
         "rollout": None,
+        # session-affinity graph-prep cache (serve/prep.py): capacity of the
+        # per-model LRU keyed on the client session_id; 0 disables. A hit
+        # skips Morton relabel + blocked re-pack + remote classify for
+        # repeat-topology requests (prep_ms ~ gather-only).
+        "session_cache": 64,
         # multi-model routing (serve/registry.py): null = one model from
         # THIS config; else a list of {name, config_path?, overrides?}
         # entries, each owning its own engine + queue + warmup
@@ -406,6 +411,23 @@ def validate_config(cfg: ConfigDict) -> None:
         raise ValueError("serve.donate must be true, false, or 'auto'")
     if float(s.get("result_margin_s", 30.0)) <= 0:
         raise ValueError("serve.result_margin_s must be > 0")
+    if int(s.get("session_cache", 0)) < 0:
+        raise ValueError("serve.session_cache must be >= 0 (0 disables)")
+    r = s.get("rollout")
+    if r is not None:
+        if not isinstance(r, Mapping):
+            raise ValueError("serve.rollout must be null or a mapping of "
+                             "make_rollout_fn kwargs (radius, max_degree, ...)")
+        if float(r.get("radius", 0.0)) <= 0:
+            raise ValueError("serve.rollout.radius must be > 0")
+        if int(r.get("max_degree", 0)) < 1:
+            raise ValueError("serve.rollout.max_degree must be >= 1")
+        if int(r.get("max_per_cell", 16)) < 1:
+            raise ValueError("serve.rollout.max_per_cell must be >= 1")
+        if (int(r.get("max_degree", 0))
+                * int(r.get("edge_block", 256))) % 512:
+            raise ValueError("serve.rollout: max_degree * edge_block must be "
+                             "a multiple of 512 (the kernel edge tile)")
     models = s.get("models")
     if models is not None:
         if not isinstance(models, (list, tuple)) or not models:

@@ -36,7 +36,7 @@ consumes it unchanged; the kernels are an opt-in fast path keyed on
 from __future__ import annotations
 
 import functools
-from typing import Optional
+from typing import NamedTuple, Optional
 
 import jax
 import jax.numpy as jnp
@@ -52,47 +52,114 @@ DEFAULT_EDGE_TILE = 512   # edges per grid step = one-hot matmul K dimension
 # Host-side layout builder
 # ---------------------------------------------------------------------------
 
-def blockify_edges(
-    edge_index: np.ndarray,      # [2, e] int, rows sorted ascending
-    edge_attr: Optional[np.ndarray],  # [e, D] or None
-    n_nodes_padded: int,         # N, multiple of `block`
-    epb: int,                    # edge slots per block (multiple of edge_tile)
-    block: int = DEFAULT_BLOCK,
-):
-    """Re-lay one graph's row-sorted edge list into per-block padded slices.
-
-    Returns (edge_index' [2, NB*epb], edge_attr' [NB*epb, D], edge_mask'
-    [NB*epb]). Padding slots carry row = col = (their block's last node) so the
-    global row ordering stays ascending — the layout remains a legal
-    ``edges_sorted`` edge list for the XLA fallback path.
-    """
-    nb = n_nodes_padded // block
+def _blockify_plan(edge_index: np.ndarray, n_nodes_padded: int, epb: int,
+                   block: int):
+    """One vectorized pass: arbitrary edge order -> (src, dst, blocked index,
+    mask). ``src`` are input-edge positions sorted stably by destination row
+    (so already-sorted input keeps its order bit-for-bit); ``dst`` is each
+    sorted edge's slot ``block_idx*epb + rank_within_block``. No per-block
+    Python loop — the whole layout is two argsort/searchsorted sweeps plus
+    fancy-index writes."""
     row = edge_index[0]
-    # block boundaries in the sorted row array
-    bounds = np.searchsorted(row, np.arange(nb + 1) * block)
+    e = int(row.shape[0])
+    nb = n_nodes_padded // block
+    src = np.argsort(row, kind="stable")
+    rows = row[src]
+    bounds = np.searchsorted(rows, np.arange(nb + 1) * block)
     counts = np.diff(bounds)
     if counts.max(initial=0) > epb:
         raise ValueError(f"blockify_edges: epb={epb} < max block degree {counts.max()}")
-    if bounds[-1] != edge_index.shape[1]:
+    if bounds[-1] != e:
         raise ValueError("blockify_edges: edge rows exceed n_nodes_padded")
-
+    dst = (np.repeat(np.arange(nb, dtype=np.int64) * epb, counts)
+           + np.arange(e, dtype=np.int64)
+           - np.repeat(bounds[:-1].astype(np.int64), counts))
     E = nb * epb
     new_index = np.empty((2, E), np.int32)
     pad_rows = np.arange(1, nb + 1, dtype=np.int32) * block - 1
     new_index[0] = np.repeat(pad_rows, epb)
     new_index[1] = new_index[0]
+    new_index[:, dst] = edge_index[:, src]
     new_mask = np.zeros((E,), np.float32)
+    new_mask[dst] = 1.0
+    return src, dst, new_index, new_mask
+
+
+def blockify_edges(
+    edge_index: np.ndarray,      # [2, e] int, ANY edge order
+    edge_attr: Optional[np.ndarray],  # [e, D] or None
+    n_nodes_padded: int,         # N, multiple of `block`
+    epb: int,                    # edge slots per block (multiple of edge_tile)
+    block: int = DEFAULT_BLOCK,
+):
+    """Re-lay one graph's edge list into per-block padded slices.
+
+    Returns (edge_index' [2, NB*epb], edge_attr' [NB*epb, D], edge_mask'
+    [NB*epb]). Padding slots carry row = col = (their block's last node) so the
+    global row ordering stays ascending — the layout remains a legal
+    ``edges_sorted`` edge list for the XLA fallback path. Vectorized (one
+    NumPy pass, no per-block loop); row-sorted input reproduces the historic
+    layout bit-for-bit, arbitrary order is stably row-sorted first.
+    """
+    src, dst, new_index, new_mask = _blockify_plan(
+        edge_index, n_nodes_padded, epb, block)
     D = edge_attr.shape[1] if edge_attr is not None else 0
-    new_attr = np.zeros((E, D), np.float32)
-    for b in range(nb):
-        lo, hi = bounds[b], bounds[b + 1]
-        n = hi - lo
-        dst = b * epb
-        new_index[:, dst:dst + n] = edge_index[:, lo:hi]
-        new_mask[dst:dst + n] = 1.0
-        if D and edge_attr is not None:
-            new_attr[dst:dst + n] = edge_attr[lo:hi]
+    new_attr = np.zeros((new_mask.shape[0], D), np.float32)
+    if D and edge_attr is not None:
+        new_attr[dst] = edge_attr[src]
     return new_index, new_attr, new_mask
+
+
+class RepackPlan(NamedTuple):
+    """Topology-only artifact of :func:`repack_blocked` — everything about a
+    graph's blocked layout that does NOT depend on positions/attributes, so a
+    session serving the same scene can re-apply it to fresh per-step arrays
+    with two fancy-index gathers (the serve prep cache's hit path).
+
+    perm[new] = old Morton node relabel (None when built without loc);
+    edge_index/edge_mask are the blocked [2, NB*epb]/[NB*epb] arrays;
+    src/dst map client edge k's payload to slot dst via attr'[dst] = attr[src].
+    """
+    perm: Optional[np.ndarray]
+    edge_index: np.ndarray
+    edge_mask: np.ndarray
+    src: np.ndarray
+    dst: np.ndarray
+    stamp: tuple                 # (n_nodes_padded, epb, block)
+
+    def apply_edge_attr(self, edge_attr: np.ndarray) -> np.ndarray:
+        out = np.zeros((self.edge_mask.shape[0], edge_attr.shape[1]),
+                       np.float32)
+        out[self.dst] = edge_attr[self.src]
+        return out
+
+
+def repack_blocked(edge_index: np.ndarray, loc: Optional[np.ndarray] = None,
+                   *, n_nodes_padded: int, epb: int,
+                   block: int = DEFAULT_BLOCK, bits: int = 16) -> RepackPlan:
+    """Arbitrary client edge order -> the kernels' Morton/blocked layout in
+    one vectorized NumPy pass (sort-by-(block, row), no per-block loop).
+
+    When ``loc`` is given the node ids are first relabeled along the Z-order
+    curve (ops/order.py) so spatially-near nodes share blocks — the layout
+    the fused kernel's locality analysis assumes. Returns a :class:`RepackPlan`
+    whose ``src``/``dst`` index maps let position-dependent payloads
+    (edge_attr) be re-laid later without redoing the sort.
+    """
+    ei = np.asarray(edge_index).astype(np.int64, copy=False)
+    perm = None
+    if loc is not None:
+        from distegnn_tpu.ops.order import morton_perm
+
+        perm = morton_perm(np.asarray(loc), bits=bits)
+        inv = np.empty_like(perm)
+        inv[perm] = np.arange(perm.shape[0], dtype=perm.dtype)
+        ei = inv[ei]
+    src, dst, new_index, new_mask = _blockify_plan(
+        ei, n_nodes_padded, epb, block)
+    return RepackPlan(perm=perm, edge_index=new_index, edge_mask=new_mask,
+                      src=src, dst=dst,
+                      stamp=(n_nodes_padded, epb, block))
 
 
 def max_block_degree(rows_sorted: np.ndarray, n_nodes_padded: int,
@@ -146,6 +213,16 @@ def prepare_blocked_graph(g: dict, n_nodes_padded: int, epb: int, block: int,
     if g.get("_blockified") == stamp:
         return g
     g = dict(g)
+    if g.get("_blockified") is not None and g.get("_edge_mask") is not None:
+        # already blocked under DIFFERENT layout params (e.g. a session-cached
+        # dict co-batched with a denser peer): recover the real edge list from
+        # the mask before re-packing — padding slots must not become edges
+        keep = g["_edge_mask"] > 0
+        g["edge_index"] = g["edge_index"][:, keep]
+        if g.get("edge_attr") is not None:
+            g["edge_attr"] = g["edge_attr"][keep]
+        for k in ("_edge_pair", "_edge_mask", "_blockified", "_remote_sel"):
+            g.pop(k, None)
     if np.any(np.diff(g["edge_index"][0]) < 0):
         order = np.argsort(g["edge_index"][0], kind="stable")
         g["edge_index"] = g["edge_index"][:, order]

@@ -194,20 +194,37 @@ def split_remote_edges(edge_index: np.ndarray, edge_attr: np.ndarray,
     remote_mask [Er]) padded to ``n_pad`` (default: next multiple of 128).
     Padding points at node 0 with mask 0 — the pad_graphs convention.
     """
+    r_idx = remote_selection(edge_index, block=block, n_nodes=n_nodes)
+    return pad_remote_list(edge_index[:, r_idx], edge_attr[r_idx],
+                           n_pad=n_pad)
+
+
+def remote_selection(edge_index: np.ndarray, *, block: int,
+                     n_nodes: int) -> np.ndarray:
+    """Row-sorted indices of the out-of-window edges — the expensive half of
+    :func:`split_remote_edges`, split out so the serve session cache can store
+    it once per topology and re-gather fresh attrs per request."""
     remote = _remote_sel(edge_index, block, n_nodes)
     row = edge_index[0]
     r_idx = np.where(remote)[0]
-    r_idx = r_idx[np.argsort(row[r_idx], kind="stable")]
-    er = r_idx.size
+    return r_idx[np.argsort(row[r_idx], kind="stable")]
+
+
+def pad_remote_list(ei_r: np.ndarray, ea_r: np.ndarray,
+                    n_pad: Optional[int] = None
+                    ) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """Pad a compact remote edge list to ``n_pad`` (default next multiple of
+    128); padding points at node 0 with mask 0 — the pad_graphs convention."""
+    er = ei_r.shape[1]
     if n_pad is None:
         n_pad = max(((er + 127) // 128) * 128, 128)
     if er > n_pad:
         raise ValueError(f"{er} remote edges exceed pad {n_pad}")
     ei = np.zeros((2, n_pad), np.int32)
-    ea = np.zeros((n_pad, edge_attr.shape[1]), edge_attr.dtype)
+    ea = np.zeros((n_pad, ea_r.shape[1]), ea_r.dtype)
     m = np.zeros((n_pad,), np.float32)
-    ei[:, :er] = edge_index[:, r_idx]
-    ea[:er] = edge_attr[r_idx]
+    ei[:, :er] = ei_r
+    ea[:er] = ea_r
     m[:er] = 1.0
     return ei, ea, m
 

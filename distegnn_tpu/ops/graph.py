@@ -201,13 +201,24 @@ def pad_graphs(
                      if all(p is not None for p in pairs) else None)
         E = (N // edge_block) * edges_per_block
         if split_remote:
-            from distegnn_tpu.ops.edge_pipeline import split_remote_edges
+            from distegnn_tpu.ops.edge_pipeline import (pad_remote_list,
+                                                        split_remote_edges)
 
             # classify on each graph's REAL blockified edges (padding slots
             # carry row == col inside their own block — always in-window —
             # so filtering by mask only removes never-remote slots)
             outs = []
             for g in graphs:
+                sel = g.get("_remote_sel")
+                if (sel is not None
+                        and g.get("_blockified") == (N, edges_per_block,
+                                                     edge_block)):
+                    # session-cached selection: the classify+sort was done at
+                    # prep time; a gather of the current arrays suffices
+                    outs.append(pad_remote_list(
+                        g["edge_index"][:, sel], g["edge_attr"][sel],
+                        n_pad=remote_pad))
+                    continue
                 keep = g["_edge_mask"] > 0
                 outs.append(split_remote_edges(
                     g["edge_index"][:, keep], g["edge_attr"][keep],

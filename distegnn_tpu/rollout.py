@@ -118,3 +118,51 @@ def make_rollout_fn(
         return traj, over
 
     return rollout
+
+
+def make_batched_rollout_fn(
+    model,
+    radius: float,
+    max_degree: int,
+    max_per_cell: int = 16,
+    feature_fn: Callable = default_feature_fn,
+    edge_attr_fn: Callable = default_edge_attr_fn,
+    node_attr: Optional[jnp.ndarray] = None,
+    edge_block: int = 256,
+    velocity_from_delta: bool = True,
+    velocity_scale: float = 1.0,
+):
+    """Batched variant of :func:`make_rollout_fn`: a leading SCENE axis.
+
+    ``rollout_batch(params, loc0 [B,N,3], vel0 [B,N,3], node_mask [B,N],
+    steps)`` -> (traj [B, steps, N, 3], overflow [B, steps] bool).
+
+    Structure: ONE ``lax.scan`` over steps whose body is the single-scene
+    step ``vmap``-ed over scenes — every scene rebuilds its own radius graph
+    per step, but all B scenes advance inside one executable, so the serve
+    path amortizes dispatch/pad/sync over the whole micro-batch instead of
+    paying it per scene (the B=1 throughput hole). All shapes are static, so
+    the vmap is shape-preserving and the compile cache keys stay (n_pad,
+    steps, B). Per-scene trajectories match B independent calls of the
+    unbatched rollout (parity tested to 1e-6).
+    """
+    single = make_rollout_fn(
+        model, radius, max_degree, max_per_cell=max_per_cell,
+        feature_fn=feature_fn, edge_attr_fn=edge_attr_fn,
+        node_attr=node_attr, edge_block=edge_block,
+        velocity_from_delta=velocity_from_delta,
+        velocity_scale=velocity_scale)
+
+    def rollout_batch(params, loc0, vel0, node_mask, steps: int,
+                      feat_args=(),
+                      node_attr_now: Optional[jnp.ndarray] = None,
+                      ) -> Tuple[jnp.ndarray, jnp.ndarray]:
+        if loc0.ndim != 3:
+            raise ValueError(f"rollout_batch expects loc0 [B, N, 3], got "
+                             f"shape {tuple(loc0.shape)}")
+        fn = lambda l, v, m: single(params, l, v, m, steps,
+                                    feat_args=feat_args,
+                                    node_attr_now=node_attr_now)
+        return jax.vmap(fn)(loc0, vel0, node_mask)
+
+    return rollout_batch

@@ -9,14 +9,18 @@ process-wide admission/latency series and a /metrics scrape endpoint.
 
 from distegnn_tpu.serve.buckets import (Bucket, BucketLadder,
                                         BucketOverflowError, synthetic_graph)
-from distegnn_tpu.serve.engine import InferenceEngine, RolloutOverflowError
+from distegnn_tpu.serve.engine import (InferenceEngine,
+                                       MixedRolloutStepsError,
+                                       RolloutOverflowError)
 from distegnn_tpu.serve.metrics import ServeMetrics
+from distegnn_tpu.serve.prep import PrepPlan, PrepResult, SessionPrepCache
 from distegnn_tpu.serve.queue import (QueueFullError, RequestQueue,
                                       RequestTimeoutError, ServeFuture)
 
 __all__ = [
     "Bucket", "BucketLadder", "BucketOverflowError", "synthetic_graph",
-    "InferenceEngine", "RolloutOverflowError", "ServeMetrics",
+    "InferenceEngine", "MixedRolloutStepsError", "RolloutOverflowError",
+    "ServeMetrics", "PrepPlan", "PrepResult", "SessionPrepCache",
     "QueueFullError", "RequestQueue", "RequestTimeoutError", "ServeFuture",
     "engine_from_config", "Gateway", "ModelEntry", "ModelRegistry",
     "PayloadError",
@@ -56,7 +60,8 @@ def engine_from_config(cfg, model, params, metrics=None):
         model, params, ladder=ladder, max_batch=s.max_batch,
         cache_size=s.cache_size, donate=s.donate, metrics=metrics,
         rollout_opts=(s.rollout.to_dict() if s.get("rollout") else None),
-        layout_opts=layout)
+        layout_opts=layout,
+        session_cache=int(s.get("session_cache", 0) or 0))
     q = RequestQueue(
         engine, batch_deadline_ms=s.batch_deadline_ms,
         queue_capacity=s.queue_capacity,

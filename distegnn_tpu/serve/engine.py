@@ -45,6 +45,13 @@ class RolloutOverflowError(RuntimeError):
     (max_per_cell / max_degree) — results would silently drop edges."""
 
 
+class MixedRolloutStepsError(ValueError):
+    """A rollout micro-batch mixed different steps-K. The scan length is
+    static (part of the compiled executable), so scenes with different K can
+    never share a batch — the batcher keys on (rung, steps) to prevent this;
+    hitting it through ``rollout_batch`` directly is a caller bug."""
+
+
 class InferenceEngine:
     """Bucketed, compile-cached inference over one model + params.
 
@@ -64,6 +71,9 @@ class InferenceEngine:
         edge_tile, split_remote) — a model with ``edge_impl='fused'`` needs
         ``{'edge_block': 512, 'split_remote': True}`` so every served batch
         carries the blocked layout + remote tail.
+      session_cache: capacity of the session-affinity prep cache
+        (serve/prep.py) exposed as ``engine.prep_cache``; 0 (default)
+        disables it.
     """
 
     def __init__(self, model, params, *, ladder: Optional[BucketLadder] = None,
@@ -71,7 +81,8 @@ class InferenceEngine:
                  donate: Any = "auto", metrics: Optional[ServeMetrics] = None,
                  apply_fn: Optional[Callable] = None,
                  rollout_opts: Optional[dict] = None,
-                 layout_opts: Optional[dict] = None):
+                 layout_opts: Optional[dict] = None,
+                 session_cache: int = 0):
         if max_batch < 1:
             raise ValueError("max_batch must be >= 1")
         if cache_size < 1:
@@ -86,6 +97,17 @@ class InferenceEngine:
             lambda p, batch: model.apply(p, batch)[0])
         self._rollout_opts = dict(rollout_opts or {})
         self._layout_opts = dict(layout_opts or {})
+        # session-affinity prep cache (serve/prep.py): 0 disables. Created
+        # here so the transport finds it on the engine and its hit/miss
+        # counters share this engine's metrics registry.
+        if session_cache:
+            from distegnn_tpu.serve.prep import SessionPrepCache
+
+            self.prep_cache: Optional[SessionPrepCache] = SessionPrepCache(
+                int(session_cache), ladder=self.ladder,
+                layout_opts=self._layout_opts, metrics=self.metrics)
+        else:
+            self.prep_cache = None
         if donate == "auto":
             donate = jax.default_backend() == "tpu"
         self._donate = bool(donate)
@@ -191,23 +213,50 @@ class InferenceEngine:
         return int(getattr(self.model, "edge_attr_nf", 2) or 0)
 
     # ---- K-step rollout --------------------------------------------------
+    def _rollout_fn_opts(self) -> dict:
+        """rollout_opts resolved against the MODEL's feature widths: the
+        rollout defaults (speed [N,1], distance-twice [E,2]) only fit models
+        with those exact widths, so when the config doesn't pin a
+        feature_fn/edge_attr_fn, replicate the defaults to match."""
+        opts = dict(self._rollout_opts)
+        nf = self._probe_feat_nf()
+        if "feature_fn" not in opts and nf != 1:
+            opts["feature_fn"] = lambda v: jnp.repeat(
+                jnp.linalg.norm(v, axis=-1, keepdims=True), nf, axis=-1)
+        ef = self._probe_edge_attr_nf()
+        if "edge_attr_fn" not in opts and ef != 2:
+            def edge_attr_fn(x, ei, em, _ef=max(ef, 1)):
+                d = jnp.linalg.norm(x[ei[0]] - x[ei[1]], axis=-1,
+                                    keepdims=True)
+                return jnp.repeat(d, _ef, axis=-1) * em[:, None]
+
+            opts["edge_attr_fn"] = edge_attr_fn
+        return opts
+
+    def rollout_rung(self, n: int) -> int:
+        """Padded node count the rollout path compiles for a scene of ``n``
+        nodes: the node-ladder rung rounded up to a multiple of the rollout
+        edge_block. The batcher groups rollout requests on this value (plus
+        steps) so same-rung scenes share one executable."""
+        if not self._rollout_opts:
+            raise ValueError("engine built without rollout_opts; pass "
+                             "rollout_opts={'radius': ..., 'max_degree': ...}")
+        edge_block = int(self._rollout_opts.get("edge_block", 256))
+        rung = self.ladder._rung(n, self.ladder.node_floor,
+                                 self.ladder.node_multiple,
+                                 self.ladder.max_nodes, "nodes")
+        return -(-max(rung, edge_block) // edge_block) * edge_block
+
     def rollout(self, loc0: np.ndarray, vel0: np.ndarray, steps: int,
                 node_mask: Optional[np.ndarray] = None) -> np.ndarray:
         """K-step autoregressive rollout of one graph; returns the UNPADDED
         trajectory [steps, n, 3]. Raises RolloutOverflowError if any step
         overflowed the static neighbor-capacity bounds."""
-        if not self._rollout_opts:
-            raise ValueError("engine built without rollout_opts; pass "
-                             "rollout_opts={'radius': ..., 'max_degree': ...}")
         from distegnn_tpu.rollout import make_rollout_fn
 
-        opts = dict(self._rollout_opts)
-        edge_block = int(opts.get("edge_block", 256))
         n = int(loc0.shape[0])
-        rung = self.ladder._rung(n, self.ladder.node_floor,
-                                 self.ladder.node_multiple,
-                                 self.ladder.max_nodes, "nodes")
-        n_pad = -(-max(rung, edge_block) // edge_block) * edge_block
+        n_pad = self.rollout_rung(n)
+        opts = self._rollout_fn_opts()
         loc_p = np.zeros((n_pad, 3), np.float32)
         vel_p = np.zeros((n_pad, 3), np.float32)
         mask = np.zeros((n_pad,), np.float32)
@@ -228,3 +277,65 @@ class InferenceEngine:
                 f"{np.nonzero(np.asarray(over))[0].tolist()}; raise "
                 f"max_degree/max_per_cell in rollout_opts")
         return np.asarray(traj)[:, :n]
+
+    def rollout_batch(self, scenes: Sequence[dict]) -> List[np.ndarray]:
+        """Batched K-step rollout over same-rung scenes.
+
+        Each scene dict carries ``loc`` [n, 3], ``vel`` [n, 3], ``steps``
+        (int), and optionally ``node_mask`` [n]. All scenes MUST share the
+        same ``steps`` (the scan length is compiled in) — mixing raises
+        :class:`MixedRolloutStepsError`. Scenes are padded to one common
+        node rung and the scene axis to ``max_batch`` (replicating scene 0,
+        copies discarded), so a (rung, steps) pair owns exactly one
+        executable — the predict-path batching contract, applied to
+        rollouts. Returns per-scene UNPADDED trajectories [steps, n_i, 3].
+        """
+        if not scenes:
+            return []
+        if len(scenes) > self.max_batch:
+            raise ValueError(f"{len(scenes)} scenes > max_batch {self.max_batch}")
+        from distegnn_tpu.rollout import make_batched_rollout_fn
+
+        steps_set = {int(s["steps"]) for s in scenes}
+        if len(steps_set) != 1:
+            raise MixedRolloutStepsError(
+                f"rollout batch mixes steps {sorted(steps_set)}; scenes with "
+                f"different K cannot share a compiled scan")
+        steps = steps_set.pop()
+        ns = [int(s["loc"].shape[0]) for s in scenes]
+        n_pad = max(self.rollout_rung(n) for n in ns)
+        B = self.max_batch
+        loc_p = np.zeros((B, n_pad, 3), np.float32)
+        vel_p = np.zeros((B, n_pad, 3), np.float32)
+        mask = np.zeros((B, n_pad), np.float32)
+        for i, (s, n) in enumerate(zip(scenes, ns)):
+            loc_p[i, :n], vel_p[i, :n] = s["loc"], s["vel"]
+            nm = s.get("node_mask")
+            mask[i, :n] = (nm if nm is not None else np.ones(n)).astype(np.float32)
+        # fill pad slots with scene 0 so the replicated work is well-posed
+        # (an all-zero scene would collapse every node into one radius cell)
+        for i in range(len(scenes), B):
+            loc_p[i], vel_p[i], mask[i] = loc_p[0], vel_p[0], mask[0]
+
+        opts = self._rollout_fn_opts()
+
+        def build():
+            ro = make_batched_rollout_fn(self.model, **opts)
+            return jax.jit(functools.partial(ro, steps=steps))
+
+        fn = self._compiled(("rollout_batch", n_pad, steps, B), build)
+        with obs.span("serve/execute", n=n_pad, e=0, filled=len(scenes),
+                      capacity=B, workload="rollout", steps=steps):
+            traj, over = fn(self.params, jnp.asarray(loc_p),
+                            jnp.asarray(vel_p), jnp.asarray(mask))
+            traj = np.asarray(traj)                      # [B, steps, n_pad, 3]
+        over = np.asarray(over)[: len(scenes)]           # replicas don't count
+        if bool(over.any()):
+            self.metrics.failed()
+            bad = [(int(i), np.nonzero(over[i])[0].tolist())
+                   for i in np.nonzero(over.any(axis=1))[0]]
+            raise RolloutOverflowError(
+                f"batched rollout overflowed radius-graph capacity "
+                f"(scene, steps): {bad}; raise max_degree/max_per_cell in "
+                f"rollout_opts")
+        return [traj[i, :, :n].copy() for i, n in enumerate(ns)]

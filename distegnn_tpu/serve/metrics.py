@@ -29,6 +29,7 @@ _COUNTERS = (
     "requests_poison", "worker_restarts", "batches_executed",
     "batch_slots_total", "batch_slots_filled",
     "cache_hits", "cache_misses", "cache_evictions",
+    "session_hits", "session_misses", "session_evictions",
 )
 
 
@@ -105,6 +106,13 @@ class ServeMetrics:
         if evicted:
             self._c["cache_evictions"].add(evicted)
 
+    def session_event(self, hit: bool, evicted: int = 0) -> None:
+        """One session-affinity prep-cache lookup (distinct from the compile
+        cache tracked by :meth:`cache_event`)."""
+        self._c["session_hits" if hit else "session_misses"].add(1)
+        if evicted:
+            self._c["session_evictions"].add(evicted)
+
     def set_queue_depth(self, depth: int) -> None:
         self._qdepth.set(depth)
 
@@ -134,6 +142,9 @@ class ServeMetrics:
             "cache_hits": c["cache_hits"],
             "cache_misses": c["cache_misses"],
             "cache_evictions": c["cache_evictions"],
+            "session_hits": c["session_hits"],
+            "session_misses": c["session_misses"],
+            "session_evictions": c["session_evictions"],
             "queue_depth": int(self._qdepth.value),
         }
 

@@ -6,6 +6,13 @@ when it reaches the batch cap OR its oldest request has waited the batching
 deadline — the classic latency/throughput knob (deadline 0 = no batching,
 larger = fuller batches, +deadline worst-case added latency).
 
+Rollout requests (``submit_rollout``) ride the SAME machinery: they share
+the ingress, deadlines, poison isolation, and restart containment, but
+coalesce per (node rung, steps) — the compiled scan length is static, so
+scenes with a different K land in a different pending list and can never
+co-batch (engine.rollout_batch additionally raises MixedRolloutStepsError
+as the typed backstop).
+
 Failure surfaces (never silent, matching the overflow-flag contract in
 rollout.py):
   - ingress full            -> QueueFullError raised AT SUBMIT (backpressure)
@@ -90,15 +97,26 @@ class ServeFuture:
 
 
 class _Request:
-    __slots__ = ("graph", "bucket", "future", "t_submit", "deadline")
+    __slots__ = ("graph", "bucket", "kind", "steps", "future", "t_submit",
+                 "deadline")
 
     def __init__(self, graph: dict, bucket: Bucket, deadline: float,
-                 hard_deadline: Optional[float] = None):
+                 hard_deadline: Optional[float] = None,
+                 kind: str = "predict", steps: Optional[int] = None):
         self.graph = graph
         self.bucket = bucket
+        self.kind = kind        # "predict" | "rollout"
+        self.steps = steps      # rollout scan length (None for predicts)
         self.future = ServeFuture(hard_deadline=hard_deadline)
         self.t_submit = time.perf_counter()
         self.deadline = deadline
+
+    @property
+    def key(self):
+        """Micro-batch coalescing key: same-rung predicts batch together as
+        before; rollouts additionally key on steps (the compiled scan length)
+        so mixed-K scenes never co-batch."""
+        return (self.kind, self.bucket, self.steps)
 
 
 _STOP = object()
@@ -137,7 +155,9 @@ class RequestQueue:
         self.request_timeout = request_timeout_ms / 1e3
         self.result_margin = float(result_margin_s)
         self._ingress: "_pyqueue.Queue" = _pyqueue.Queue(maxsize=queue_capacity)
-        self._pending: Dict[Bucket, List[_Request]] = {}
+        # keyed on _Request.key = (kind, bucket, steps): predicts coalesce
+        # per rung exactly as before; rollouts per (rung, steps)
+        self._pending: Dict[tuple, List[_Request]] = {}
         self._thread: Optional[threading.Thread] = None
         self._started = False
         self._restarts = 0
@@ -209,17 +229,47 @@ class RequestQueue:
         self.stop()
 
     # ---- submission ------------------------------------------------------
-    def submit(self, graph: dict) -> ServeFuture:
+    def submit(self, graph: dict,
+               bucket: Optional[Bucket] = None) -> ServeFuture:
         """Admit one pad_graphs-style graph dict; returns a ServeFuture
-        resolving to the predicted positions [n, 3] (numpy)."""
+        resolving to the predicted positions [n, 3] (numpy). ``bucket``
+        overrides the ladder assignment — the session prep cache passes the
+        rung it computed from the RAW topology, since a prepared (blocked)
+        dict's inflated edge count would otherwise re-bucket it."""
         if not self._started:
             raise RuntimeError("RequestQueue not started (use start() or a "
                                "with-block)")
-        bucket = self.ladder.bucket_of_graph(graph)  # BucketOverflowError here
+        if bucket is None:
+            bucket = self.ladder.bucket_of_graph(graph)  # BucketOverflowError
         now = time.perf_counter()
         req = _Request(graph, bucket, deadline=now + self.request_timeout,
                        hard_deadline=(now + self.request_timeout
                                       + self.result_margin))
+        return self._enqueue(req)
+
+    def submit_rollout(self, scene: dict) -> ServeFuture:
+        """Admit one rollout scene dict (``loc`` [n,3], ``vel`` [n,3],
+        ``steps`` int, optional ``node_mask``); resolves to the trajectory
+        [steps, n, 3]. Same deadline/backpressure semantics as ``submit`` —
+        rollouts share the ingress, deadlines, and restart containment; they
+        coalesce per (node rung, steps), so same-shape same-K scenes fill one
+        compiled scan exactly like predicts fill a padded batch."""
+        if not self._started:
+            raise RuntimeError("RequestQueue not started (use start() or a "
+                               "with-block)")
+        steps = int(scene.get("steps", 0))
+        if steps < 1:
+            raise ValueError(f"rollout steps must be >= 1, got {steps}")
+        n_pad = self.engine.rollout_rung(int(scene["loc"].shape[0]))
+        now = time.perf_counter()
+        req = _Request(scene, Bucket(n_pad, 0),
+                       deadline=now + self.request_timeout,
+                       hard_deadline=(now + self.request_timeout
+                                      + self.result_margin),
+                       kind="rollout", steps=steps)
+        return self._enqueue(req)
+
+    def _enqueue(self, req: _Request) -> ServeFuture:
         try:
             self._ingress.put_nowait(req)
         except _pyqueue.Full:
@@ -268,7 +318,7 @@ class RequestQueue:
             if not item[1]:  # drain=False: fail everything outstanding
                 self._fail_all(RequestTimeoutError("server stopped"))
             return True
-        self._pending.setdefault(item.bucket, []).append(item)
+        self._pending.setdefault(item.key, []).append(item)
         return False
 
     def _loop(self) -> None:
@@ -299,48 +349,55 @@ class RequestQueue:
             self.metrics.set_queue_depth(self.depth())
 
             now = time.perf_counter()
-            for bucket in list(self._pending):
-                reqs = self._pending[bucket]
-                self._expire(bucket, reqs, now)
+            for key in list(self._pending):
+                reqs = self._pending[key]
+                self._expire(key, reqs, now)
                 while len(reqs) >= self.engine.max_batch:
-                    self._execute(bucket, reqs[: self.engine.max_batch])
+                    self._execute(key, reqs[: self.engine.max_batch])
                     del reqs[: self.engine.max_batch]
                 if reqs and (draining or
                              now - reqs[0].t_submit >= self.batch_deadline):
-                    self._execute(bucket, reqs)
+                    self._execute(key, reqs)
                     reqs.clear()
                 if not reqs:
-                    del self._pending[bucket]
+                    del self._pending[key]
             self.metrics.set_queue_depth(self.depth())
             if draining and not self._pending and self._ingress.empty():
                 return
 
-    def _expire(self, bucket: Bucket, reqs: List[_Request], now: float) -> None:
+    def _expire(self, key, reqs: List[_Request], now: float) -> None:
         alive = [r for r in reqs if r.deadline > now]
         for r in reqs:
             if r.deadline <= now:
                 self.metrics.timed_out()
                 r.future.set_exception(RequestTimeoutError(
                     f"request waited > {self.request_timeout * 1e3:.0f} ms "
-                    f"in bucket {bucket}"))
+                    f"in bucket {key[1]}"))
         reqs[:] = alive
 
-    def _execute(self, bucket: Bucket, reqs: List[_Request]) -> None:
+    def _run_batch(self, key, graphs: List[dict]) -> List:
+        """One engine call for a coalesced micro-batch; dispatch on kind."""
+        kind, bucket, _steps = key
+        if kind == "rollout":
+            return self.engine.rollout_batch(graphs)
+        return self.engine.predict_batch(graphs, bucket=bucket)
+
+    def _execute(self, key, reqs: List[_Request]) -> None:
+        kind, bucket, steps = key
         t_start = time.perf_counter()
         try:
-            outs = self.engine.predict_batch([r.graph for r in reqs],
-                                             bucket=bucket)
+            outs = self._run_batch(key, [r.graph for r in reqs])
         except Exception:
             # one bad graph fails the whole padded batch — retry each request
             # ALONE once, so a poison graph only takes down itself
-            self._retry_individually(bucket, reqs)
+            self._retry_individually(key, reqs)
             return
         now = time.perf_counter()
         lats = [(now - r.t_submit) * 1e3 for r in reqs]
         qms = [(t_start - r.t_submit) * 1e3 for r in reqs]
         self.metrics.batch_done(len(reqs), self.engine.max_batch, lats, qms)
         obs.event("serve/batch", n=bucket.n, e=bucket.e, filled=len(reqs),
-                  capacity=self.engine.max_batch,
+                  capacity=self.engine.max_batch, workload=kind,
                   dur_s=round(now - t_start, 6))
         compute_ms = round((now - t_start) * 1e3, 3)
         for r, out, q_ms in zip(reqs, outs, qms):
@@ -350,12 +407,13 @@ class RequestQueue:
                                  bucket_n=bucket.n, bucket_e=bucket.e)
             r.future.set_result(out)
 
-    def _retry_individually(self, bucket: Bucket, reqs: List[_Request]) -> None:
+    def _retry_individually(self, key, reqs: List[_Request]) -> None:
+        _kind, bucket, _steps = key
         self.metrics.retried(len(reqs))
         for r in reqs:
             t_start = time.perf_counter()
             try:
-                out = self.engine.predict_batch([r.graph], bucket=bucket)[0]
+                out = self._run_batch(key, [r.graph])[0]
             except Exception as solo_exc:  # fails even alone: the poison graph
                 self.metrics.poison()
                 self.metrics.failed()

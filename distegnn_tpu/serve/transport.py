@@ -5,7 +5,13 @@ dependencies) over one or more :class:`~distegnn_tpu.serve.queue.RequestQueue`
 instances routed by a :class:`~distegnn_tpu.serve.registry.ModelRegistry`:
 
   POST /v1/models/<name>/predict   JSON graph -> prediction (+ bucket,
-                                   queue_ms, compute_ms, batch_filled)
+                                   queue_ms, compute_ms, batch_filled);
+                                   an optional ``session_id`` routes graph
+                                   prep through the engine's session cache
+  POST /v1/models/<name>/rollout   JSON scene (positions, steps, optional
+                                   velocities/node_mask) -> K-step
+                                   trajectory; 501 unless the model was
+                                   built with serve.rollout
   GET  /v1/models                  routing table: rungs, warmup state, depth
   GET  /metrics                    Prometheus text: the process-wide obs
                                    MetricsRegistry + each model's serve
@@ -49,6 +55,7 @@ import numpy as np
 from distegnn_tpu import obs
 from distegnn_tpu.obs.metrics import MetricsRegistry, _prom_name
 from distegnn_tpu.serve.buckets import BucketOverflowError
+from distegnn_tpu.serve.engine import RolloutOverflowError
 from distegnn_tpu.serve.queue import QueueFullError, RequestTimeoutError
 from distegnn_tpu.serve.registry import ModelRegistry
 
@@ -167,12 +174,53 @@ def graph_from_payload(payload: dict, feat_nf: int,
             "edge_attr": attr.astype(np.float32)}
 
 
+def scene_from_payload(payload: dict) -> dict:
+    """Validate a rollout body and build the scene dict
+    ``RequestQueue.submit_rollout`` consumes. Required: ``positions`` [n,3]
+    and ``steps`` (int >= 1). Optional: ``velocities`` (default zeros) and
+    ``node_mask`` [n] (default all ones). No edge topology: the rollout
+    rebuilds its radius graph on device every step."""
+    if not isinstance(payload, dict):
+        raise PayloadError("body must be a JSON object")
+    loc = decode_array(payload.get("positions", payload.get("loc")),
+                       "<f4", "positions")
+    if loc.ndim != 2 or loc.shape[1] != 3 or loc.shape[0] < 1:
+        raise PayloadError(f"'positions' must be [n, 3] "
+                           f"(got {list(loc.shape)})")
+    n = int(loc.shape[0])
+    try:
+        steps = int(payload.get("steps"))
+    except (TypeError, ValueError):
+        raise PayloadError("'steps' must be an integer >= 1") from None
+    if steps < 1:
+        raise PayloadError(f"'steps' must be >= 1 (got {steps})")
+    vel_spec = payload.get("velocities", payload.get("vel"))
+    if vel_spec is None:
+        vel = np.zeros((n, 3), np.float32)
+    else:
+        vel = decode_array(vel_spec, "<f4", "velocities")
+        if vel.shape != loc.shape:
+            raise PayloadError(f"'velocities' must match positions shape "
+                               f"(got {list(vel.shape)})")
+    scene = {"loc": loc.astype(np.float32), "vel": vel.astype(np.float32),
+             "steps": steps}
+    mask_spec = payload.get("node_mask")
+    if mask_spec is not None:
+        mask = decode_array(mask_spec, "<f4", "node_mask")
+        if mask.shape != (n,):
+            raise PayloadError(f"'node_mask' must be [{n}] "
+                               f"(got {list(mask.shape)})")
+        scene["node_mask"] = mask.astype(np.float32)
+    return scene
+
+
 # ---- the gateway ------------------------------------------------------------
 
 _GATEWAY_COUNTERS = (
-    "requests_total", "predict_ok", "shed_inflight", "shed_queue_full",
-    "timeouts", "bad_requests", "unknown_model", "overflow_rejected",
-    "draining_rejected", "errors",
+    "requests_total", "predict_ok", "rollout_ok", "shed_inflight",
+    "shed_queue_full", "timeouts", "bad_requests", "unknown_model",
+    "overflow_rejected", "draining_rejected", "rollout_overflow",
+    "errors",
 )
 
 
@@ -273,9 +321,11 @@ class Gateway:
 
     # ---- request handling ------------------------------------------------
     def _route_name(self, method: str, path: str) -> str:
-        if method == "POST" and path.startswith("/v1/models/") \
-                and path.endswith("/predict"):
-            return "predict"
+        if method == "POST" and path.startswith("/v1/models/"):
+            if path.endswith("/predict"):
+                return "predict"
+            if path.endswith("/rollout"):
+                return "rollout"
         return {"/v1/models": "models", "/metrics": "metrics",
                 "/healthz": "healthz", "/readyz": "readyz"}.get(path,
                                                                 "unknown")
@@ -305,11 +355,11 @@ class Gateway:
             (time.perf_counter() - t0) * 1e3)
 
     def _handle(self, h, method: str, path: str, route: str) -> int:
-        if route == "predict":
+        if route in ("predict", "rollout"):
             if method != "POST":
                 return self._send_json(h, 405, {"error": "POST only",
                                                 "type": "MethodNotAllowed"})
-            return self._predict(h, path)
+            return self._infer(h, path, route)
         if method != "GET":
             return self._send_json(h, 405, {"error": "GET only",
                                             "type": "MethodNotAllowed"})
@@ -331,30 +381,50 @@ class Gateway:
         return self._send_json(h, 404, {"error": f"no route {path}",
                                         "type": "NotFound"})
 
-    def _predict(self, h, path: str) -> int:
-        name = path[len("/v1/models/"):-len("/predict")]
+    def _infer(self, h, path: str, route: str) -> int:
+        name = path[len("/v1/models/"):-(len(route) + 1)]
         if not self._try_acquire():
             self._c["shed_inflight"].add(1)
             return self._send_json(h, 429, {
                 "error": f"gateway at max_inflight={self.max_inflight}; "
                          "retry with backoff", "type": "Overloaded"})
         try:
-            return self._predict_admitted(h, name)
+            if not self._accepting:
+                self._c["draining_rejected"].add(1)
+                return self._send_json(h, 503, {
+                    "error": "gateway draining", "type": "Draining"})
+            try:
+                entry = self.registry.get(name)
+            except KeyError:
+                self._c["unknown_model"].add(1)
+                return self._send_json(h, 404, {
+                    "error": f"unknown model {name!r}; "
+                             f"see GET /v1/models", "type": "UnknownModel"})
+            if route == "rollout":
+                return self._rollout_admitted(h, name, entry)
+            return self._predict_admitted(h, name, entry)
         finally:
             self._release()
 
-    def _predict_admitted(self, h, name: str) -> int:
-        if not self._accepting:
-            self._c["draining_rejected"].add(1)
-            return self._send_json(h, 503, {
-                "error": "gateway draining", "type": "Draining"})
+    def _submit_guarded(self, h, submit_fn):
+        """Run one queue submit, mapping the admission errors to their HTTP
+        statuses. Returns (future, None) or (None, status)."""
         try:
-            entry = self.registry.get(name)
-        except KeyError:
-            self._c["unknown_model"].add(1)
-            return self._send_json(h, 404, {
-                "error": f"unknown model {name!r}; "
-                         f"see GET /v1/models", "type": "UnknownModel"})
+            return submit_fn(), None
+        except QueueFullError as exc:
+            self._c["shed_queue_full"].add(1)
+            return None, self._send_json(h, 429, {"error": str(exc),
+                                                  "type": "QueueFull"})
+        except BucketOverflowError as exc:
+            self._c["overflow_rejected"].add(1)
+            return None, self._send_json(h, 413, {"error": str(exc),
+                                                  "type": "BucketOverflow"})
+        except RuntimeError as exc:       # queue stopped under our feet
+            self._c["draining_rejected"].add(1)
+            return None, self._send_json(h, 503, {"error": str(exc),
+                                                  "type": "Draining"})
+
+    def _predict_admitted(self, h, name: str, entry) -> int:
         payload = self._read_json(h)
         graph = graph_from_payload(payload, entry.feat_nf,
                                    entry.edge_attr_nf)
@@ -362,33 +432,83 @@ class Gateway:
         if encoding not in ("list", "b64"):
             raise PayloadError("'encoding' must be 'list' or 'b64'")
         t0 = time.perf_counter()
-        try:
-            fut = entry.queue.submit(graph)
-        except QueueFullError as exc:
-            self._c["shed_queue_full"].add(1)
-            return self._send_json(h, 429, {"error": str(exc),
-                                            "type": "QueueFull"})
-        except BucketOverflowError as exc:
-            self._c["overflow_rejected"].add(1)
-            return self._send_json(h, 413, {"error": str(exc),
-                                            "type": "BucketOverflow"})
-        except RuntimeError as exc:       # queue stopped under our feet
-            self._c["draining_rejected"].add(1)
-            return self._send_json(h, 503, {"error": str(exc),
-                                            "type": "Draining"})
+        session = None
+        bucket = perm = None
+        session_id = payload.get("session_id")
+        cache = getattr(entry.engine, "prep_cache", None)
+        if session_id is not None and cache is not None:
+            prepped = cache.prepare(str(session_id), graph)
+            graph, bucket, perm = prepped.graph, prepped.bucket, prepped.perm
+            session = {"id": str(session_id), "hit": prepped.hit,
+                       "prep_ms": round((time.perf_counter() - t0) * 1e3, 3)}
+        fut, status = self._submit_guarded(
+            h, lambda: entry.queue.submit(graph, bucket=bucket))
+        if fut is None:
+            return status
         try:
             out = fut.result()            # bounded by the hard deadline
         except RequestTimeoutError as exc:
             self._c["timeouts"].add(1)
             return self._send_json(h, 504, {"error": str(exc),
                                             "type": "RequestTimeout"})
+        if perm is not None:
+            # the session plan served the model a Morton-relabeled graph;
+            # answer in the client's original node order
+            unperm = np.empty_like(out)
+            unperm[perm] = out
+            out = unperm
         meta = dict(fut.meta)
         self._c["predict_ok"].add(1)
-        return self._send_json(h, 200, {
+        body = {
             "model": name,
             "n": int(graph["loc"].shape[0]),
             "prediction": encode_array(out, encoding),
             "bucket": {"n": meta.get("bucket_n"), "e": meta.get("bucket_e")},
+            "queue_ms": meta.get("queue_ms"),
+            "compute_ms": meta.get("compute_ms"),
+            "batch_filled": meta.get("batch_filled"),
+            "total_ms": round((time.perf_counter() - t0) * 1e3, 3),
+        }
+        if session is not None:
+            body["session"] = session
+        return self._send_json(h, 200, body)
+
+    def _rollout_admitted(self, h, name: str, entry) -> int:
+        if not getattr(entry.engine, "_rollout_opts", None):
+            return self._send_json(h, 501, {
+                "error": f"model {name!r} was built without serve.rollout; "
+                         "set serve.rollout in its config to enable the "
+                         "endpoint", "type": "RolloutDisabled"})
+        payload = self._read_json(h)
+        scene = scene_from_payload(payload)
+        encoding = str(payload.get("encoding", "list"))
+        if encoding not in ("list", "b64"):
+            raise PayloadError("'encoding' must be 'list' or 'b64'")
+        t0 = time.perf_counter()
+        fut, status = self._submit_guarded(
+            h, lambda: entry.queue.submit_rollout(scene))
+        if fut is None:
+            return status
+        try:
+            traj = fut.result()           # bounded by the hard deadline
+        except RequestTimeoutError as exc:
+            self._c["timeouts"].add(1)
+            return self._send_json(h, 504, {"error": str(exc),
+                                            "type": "RequestTimeout"})
+        except RolloutOverflowError as exc:
+            # a well-formed request whose scene outgrew the model's static
+            # neighbor capacity — the client's to fix, not a server error
+            self._c["rollout_overflow"].add(1)
+            return self._send_json(h, 422, {"error": str(exc),
+                                            "type": "RolloutOverflow"})
+        meta = dict(fut.meta)
+        self._c["rollout_ok"].add(1)
+        return self._send_json(h, 200, {
+            "model": name,
+            "n": int(scene["loc"].shape[0]),
+            "steps": int(scene["steps"]),
+            "trajectory": encode_array(traj, encoding),
+            "bucket": {"n": meta.get("bucket_n")},
             "queue_ms": meta.get("queue_ms"),
             "compute_ms": meta.get("compute_ms"),
             "batch_filled": meta.get("batch_filled"),

@@ -19,6 +19,14 @@ arrival a POST /v1/models/bench/predict from a client thread (base64 f32
 payloads), so the BENCH line includes JSON+HTTP+routing overhead — the
 number a network client actually sees. Stdout stays exactly one line.
 
+``--workload rollout`` benches the K-step rollout path instead: it first
+measures a sequential B=1 baseline (engine.rollout per scene), then drives
+the same scenes through RequestQueue.submit_rollout so the micro-batcher
+coalesces them into batched executables (engine.rollout_batch), and reports
+batched scenes*steps/s with the B=1 number as the in-run baseline. Both
+executables are compiled during warmup, so the timed windows compare
+steady-state dispatch, not compiles.
+
 Obs: the run's structured event stream (serve/batch, serve/execute,
 jax/compile, ...) lands at --obs-dir/obs/events.jsonl (default
 logs/serve_bench/, gitignored) so hw_session.sh can archive it next to the
@@ -133,6 +141,71 @@ def _run_http(engine, q, graphs, requests, rate):
     return wall, rejected, statuses
 
 
+def _run_rollout(engine, q, graphs, scenes_n, steps, rate, warmup=True):
+    """Rollout workload: same-run B=1 baseline, then the batched path.
+
+    The B=1 baseline is the serve path WITHOUT request coalescing: each
+    scene still runs the rung's max_batch-padded executable (the
+    one-executable-per-rung contract — same as predicts), filled by a
+    single real scene. The batched window drives the same scenes through
+    ``RequestQueue.submit_rollout`` so the micro-batcher fills the padded
+    batches. A third (untimed-contract) number, ``solo``, is the unpadded
+    single-scene executable — the pre-batching client API — reported for
+    transparency.
+
+    Returns (batched_rate, b1_rate, solo_rate, wall_batched, wall_b1,
+    rejected) where rates are scenes*steps per second."""
+    from distegnn_tpu.obs import jaxprobe
+
+    scenes = [{"loc": graphs[i % len(graphs)]["loc"],
+               "vel": graphs[i % len(graphs)]["vel"], "steps": steps}
+              for i in range(scenes_n)]
+    if warmup:
+        # compile BOTH executables outside the timed windows
+        engine.rollout(scenes[0]["loc"], scenes[0]["vel"], steps)
+        engine.rollout_batch([scenes[0]])
+    jaxprobe.mark_warmup_done()
+
+    t0 = time.perf_counter()
+    for s in scenes:
+        engine.rollout(s["loc"], s["vel"], steps)
+    wall_solo = time.perf_counter() - t0
+
+    t0 = time.perf_counter()
+    for s in scenes:
+        engine.rollout_batch([s])    # fill=1: uncoalesced serve path
+    wall_b1 = time.perf_counter() - t0
+
+    rejected = 0
+    completed = 0
+    futures = []
+    t0 = time.perf_counter()
+    with q:
+        for k, s in enumerate(scenes):
+            target = t0 + k / rate
+            delay = target - time.perf_counter()
+            if delay > 0:
+                time.sleep(delay)
+            try:
+                futures.append(q.submit_rollout(s))
+            except Exception:    # QueueFullError: open loop sheds
+                rejected += 1
+        for f in futures:
+            try:
+                f.result(timeout=300.0)
+                completed += 1
+            except Exception:
+                pass  # failures are visible in the snapshot counters
+    wall_batched = time.perf_counter() - t0
+
+    # the headline only credits scenes that actually finished — a queue that
+    # sheds by timeout must not report the shed work as throughput
+    work = scenes_n * steps
+    return (completed * steps / max(wall_batched, 1e-9),
+            work / max(wall_b1, 1e-9), work / max(wall_solo, 1e-9),
+            wall_batched, wall_b1, rejected, completed)
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description="serve-stack open-loop bench")
     ap.add_argument("--config_path", type=str, default=None,
@@ -152,6 +225,18 @@ def main(argv=None) -> int:
                     default="inproc",
                     help="inproc = RequestQueue.submit directly; http = "
                          "through a live gateway socket (serve/transport.py)")
+    ap.add_argument("--workload", choices=("predict", "rollout"),
+                    default="predict",
+                    help="predict = one model step per request; rollout = "
+                         "K-step scenes through the rollout batcher, with a "
+                         "same-run B=1 baseline")
+    ap.add_argument("--rollout-steps", type=int, default=8,
+                    help="scan length K of each rollout scene")
+    ap.add_argument("--rollout-scenes", type=int, default=8,
+                    help="number of rollout scenes per timed window")
+    ap.add_argument("--max-batch", type=int, default=None,
+                    help="override serve.max_batch (compile-time bound of "
+                         "every padded batch; smaller = faster CPU traces)")
     args = ap.parse_args(argv)
 
     from distegnn_tpu import obs
@@ -160,11 +245,76 @@ def main(argv=None) -> int:
 
     cfg = (load_config(args.config_path) if args.config_path
            else ConfigDict(_DEFAULTS))
+    if args.max_batch is not None:
+        cfg.serve.max_batch = int(args.max_batch)
+    if args.workload == "rollout" and not cfg.serve.get("rollout"):
+        # the rollout path needs make_rollout_fn kwargs; default to the
+        # synthetic_graph workload's geometry when the config has none.
+        # max_degree must clear the DENSEST default scene (n=192 starts at
+        # degree 44) plus drift headroom — an overflow aborts the bench.
+        cfg.serve.rollout = {"radius": 0.35, "max_degree": 96,
+                             "max_per_cell": 128, "edge_block": 256}
+    if args.workload == "rollout":
+        # the rollout bench measures coalescing, not SLO shedding: the
+        # coalescing window must cover the whole submit ramp (scenes/rate)
+        # and a K-step CPU batch can take minutes — a serving-tuned 1 s
+        # request timeout would shed every queued scene mid-measure and
+        # quietly turn the headline into a timeout benchmark
+        ramp_ms = 1000.0 * args.rollout_scenes / max(args.rate, 1e-9)
+        cfg.serve.batch_deadline_ms = max(
+            float(cfg.serve.batch_deadline_ms), ramp_ms + 50.0)
+        cfg.serve.request_timeout_ms = max(
+            float(cfg.serve.request_timeout_ms), 600_000.0)
     if args.obs_dir:
         obs.configure_from_config(cfg, args.obs_dir,
                                   tags={"run": "serve_bench"})
     sizes = [int(s) for s in args.sizes.split(",") if s]
     engine, q, graphs = _build(cfg, sizes, args.seed)
+
+    if args.workload == "rollout":
+        if args.transport == "http":
+            print("serve_bench: --workload rollout runs inproc "
+                  "(submit_rollout); ignoring --transport http",
+                  file=sys.stderr)  # noqa: obs-print
+        obs.event("serve/bench_start", requests=args.rollout_scenes,
+                  rate=args.rate, sizes=sizes, workload="rollout",
+                  steps=args.rollout_steps)
+        batched, base, solo, wall_b, wall_1, rejected, completed = \
+            _run_rollout(
+                engine, q, graphs, args.rollout_scenes, args.rollout_steps,
+                args.rate, warmup=not args.no_warmup)
+        snap = engine.metrics.snapshot()
+        rec = {
+            "metric": "serve_rollout_throughput",
+            "value": round(batched, 3),
+            "unit": "scenes*steps/s",
+            # baseline_b1 = the uncoalesced serve path: one fill-1
+            # max_batch-padded executable call per scene. baseline_solo =
+            # the unpadded single-scene client API, for transparency.
+            "vs_baseline": round(batched / max(base, 1e-9), 3),
+            "baseline_b1": round(base, 3),
+            "baseline_solo": round(solo, 3),
+            "scenes": args.rollout_scenes,
+            "scenes_completed": completed,
+            "steps": args.rollout_steps,
+            "max_batch": engine.max_batch,
+            "rejected_at_submit": rejected,
+            "offered_rate": args.rate,
+            "sizes": sizes,
+            "wall_s": round(wall_b, 4),
+            "wall_b1_s": round(wall_1, 4),
+            "platform": __import__("jax").default_backend(),
+            "snapshot": snap,
+        }
+        print(json.dumps(rec, sort_keys=True))
+        tracer = obs.get_tracer()
+        tracer.flush()
+        w = getattr(tracer, "writer", None)
+        if w is not None:
+            print(f"obs: events at {w.path}; render with "
+                  f"python scripts/obs_report.py {w.path}",
+                  file=sys.stderr, flush=True)  # noqa: obs-print
+        return 0 if snap["requests_completed"] else 1
 
     if not args.no_warmup:
         engine.warmup([(g["loc"].shape[0], g["edge_index"].shape[1])

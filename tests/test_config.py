@@ -75,6 +75,35 @@ def test_arg_parser_roundtrip():
     assert cfg.data.batch_size == 8
 
 
+def test_validation_serve_rollout_and_session_cache():
+    from distegnn_tpu.config import validate_config
+
+    cfg = load_config(CFG)
+    cfg.serve.session_cache = -1
+    with pytest.raises(ValueError, match="session_cache"):
+        validate_config(cfg)
+    cfg.serve.session_cache = 0          # 0 disables — valid
+    cfg.serve.rollout = "radius=0.35"    # must be a mapping, not a string
+    with pytest.raises(ValueError, match="rollout"):
+        validate_config(cfg)
+    cfg.serve.rollout = {"radius": 0.0, "max_degree": 32}
+    with pytest.raises(ValueError, match="radius"):
+        validate_config(cfg)
+    cfg.serve.rollout = {"radius": 0.35, "max_degree": 0}
+    with pytest.raises(ValueError, match="max_degree"):
+        validate_config(cfg)
+    cfg.serve.rollout = {"radius": 0.35, "max_degree": 32, "max_per_cell": 0}
+    with pytest.raises(ValueError, match="max_per_cell"):
+        validate_config(cfg)
+    # max_degree * edge_block must tile the 512-wide kernel chunk
+    cfg.serve.rollout = {"radius": 0.35, "max_degree": 3, "edge_block": 256}
+    with pytest.raises(ValueError, match="multiple of 512"):
+        validate_config(cfg)
+    cfg.serve.rollout = {"radius": 0.35, "max_degree": 32,
+                         "max_per_cell": 64, "edge_block": 256}
+    validate_config(cfg)                 # the serve_bench default: valid
+
+
 def test_configdict_attribute_access():
     c = ConfigDict({"a": {"b": 1}})
     assert c.a.b == 1

@@ -100,8 +100,11 @@ class _Live:
         self.graph = synthetic_graph(28, seed=3)
         self.params = _init(self.model, self.graph)
         self.metrics = ServeMetrics()
-        self.engine = InferenceEngine(self.model, self.params, max_batch=4,
-                                      metrics=self.metrics)
+        self.engine = InferenceEngine(
+            self.model, self.params, max_batch=4, metrics=self.metrics,
+            rollout_opts={"radius": 0.35, "max_degree": 64,
+                          "max_per_cell": 64},
+            session_cache=8)
         self.queue = RequestQueue(self.engine, batch_deadline_ms=30.0,
                                   queue_capacity=64,
                                   request_timeout_ms=60_000.0,
@@ -205,6 +208,91 @@ def test_oversize_graph_413():
         gw.drain()
         t.join(timeout=30.0)
         gw.close()
+
+
+# ------------------------------------------------------------- rollout API
+
+def test_rollout_over_socket_matches_engine(live):
+    """POST /rollout returns the same trajectory as the engine's direct
+    rollout — the batched executable behind the socket changes latency,
+    never numbers."""
+    status, resp = _post(live.url("/v1/models/nbody/rollout"),
+                         {"positions": live.graph["loc"].tolist(),
+                          "velocities": live.graph["vel"].tolist(),
+                          "steps": 3})
+    assert status == 200
+    traj = np.asarray(resp["trajectory"], np.float32)
+    assert traj.shape == (3, 28, 3)
+    ref = live.engine.rollout(live.graph["loc"], live.graph["vel"], 3)
+    np.testing.assert_allclose(traj, ref, atol=1e-6, rtol=0)
+    assert resp["model"] == "nbody" and resp["n"] == 28
+    assert resp["steps"] == 3 and resp["bucket"]["n"] >= 28
+    assert resp["queue_ms"] >= 0 and resp["compute_ms"] > 0
+    assert resp["total_ms"] >= resp["compute_ms"]
+
+
+def test_rollout_bad_steps_400(live):
+    for steps in (0, -1, "three", None):
+        status, resp = _post(live.url("/v1/models/nbody/rollout"),
+                             {"positions": live.graph["loc"].tolist(),
+                              "steps": steps})
+        assert status == 400 and resp["type"] == "PayloadError"
+
+
+def test_rollout_disabled_501():
+    """A model serving without serve.rollout configured answers 501, not a
+    500 — the capability gap is part of the API, not an internal error."""
+    model = _model()
+    g = synthetic_graph(24, seed=6)
+    eng = InferenceEngine(model, _init(model, g), max_batch=2)
+    q = RequestQueue(eng, request_timeout_ms=30_000.0)
+    reg = ModelRegistry.single("noroll", eng, q)
+    reg.start()
+    gw = Gateway(reg, port=0, metrics_registry=MetricsRegistry())
+    t = threading.Thread(target=gw.serve_forever, daemon=True)
+    t.start()
+    try:
+        status, resp = _post(gw.url("/v1/models/noroll/rollout"),
+                             {"positions": g["loc"].tolist(), "steps": 2})
+        assert status == 501 and resp["type"] == "RolloutDisabled"
+    finally:
+        gw.drain()
+        t.join(timeout=30.0)
+        gw.close()
+
+
+# --------------------------------------------------------- sessions
+
+def test_predict_session_cache_hit_parity_and_metrics(live):
+    """A session_id predict pays prep once: the second request is a cache
+    hit, returns bitwise-identical numbers, and the hit counter lands in
+    GET /metrics."""
+    p = _payload(live.graph)
+    p["session_id"] = "sess-parity"
+    s1, r1 = _post(live.url("/v1/models/nbody/predict"), p)
+    s2, r2 = _post(live.url("/v1/models/nbody/predict"), p)
+    assert s1 == 200 and s2 == 200
+    assert r1["session"]["hit"] is False
+    assert r2["session"]["hit"] is True
+    assert r1["session"]["id"] == r2["session"]["id"] == "sess-parity"
+    assert r2["session"]["prep_ms"] >= 0.0   # warm hit: gather-only replay
+    np.testing.assert_array_equal(np.asarray(r1["prediction"], np.float32),
+                                  np.asarray(r2["prediction"], np.float32))
+    # parity with the sessionless path: the cache changes latency, never
+    # results
+    s0, r0 = _post(live.url("/v1/models/nbody/predict"),
+                   _payload(live.graph))
+    assert s0 == 200 and "session" not in r0
+    np.testing.assert_array_equal(np.asarray(r0["prediction"], np.float32),
+                                  np.asarray(r1["prediction"], np.float32))
+    status, text = _get(live.url("/metrics"))
+    assert status == 200
+    hits = re.search(
+        r"(?m)^distegnn_model_nbody_serve_session_hits (\S+)$", text)
+    misses = re.search(
+        r"(?m)^distegnn_model_nbody_serve_session_misses (\S+)$", text)
+    assert hits and float(hits.group(1)) >= 1
+    assert misses and float(misses.group(1)) >= 1
 
 
 # --------------------------------------------------------- operational API

@@ -51,6 +51,24 @@ import numpy as np
 # single TPU v5 lite chip (2026-07-29, 837.1 ms/step at N=113140/E=1639080).
 BASELINE_NODES_PER_SEC = 135_157.0
 
+def _emit_bench(rec, flush: bool = False) -> None:
+    """Print the BENCH contract line AND mirror it as a structured
+    ``bench/result`` obs event (logs/bench/obs/events.jsonl), binding a
+    sink on first use when no run has configured one. The stdout contract
+    must survive a broken obs import, so the mirror is best-effort."""
+    print(json.dumps(rec), flush=flush)
+    try:
+        from distegnn_tpu import obs
+
+        if not obs.get_tracer().enabled:
+            obs.configure(log_dir=os.path.join("logs", "bench", "obs"),
+                          tags={"run": "bench"})
+        obs.event("bench/result", **rec)
+        obs.flush()
+    except Exception as e:
+        print(f"bench: obs mirror failed ({e!r})", file=sys.stderr)
+
+
 def _env_int(name: str, default: int) -> int:
     """Defensive env override parse: a malformed BENCH_* var must degrade to
     the default, never crash at import — the honest-failure JSON contract
@@ -406,11 +424,11 @@ def main():
         # fused edge pipeline: kernel constraints pin the block (>= 512 and a
         # multiple of it); BENCH_FUSED_BLOCK overrides for VMEM-window sweeps
         fb = _env_int("BENCH_FUSED_BLOCK", 512)
-        print(json.dumps(measure(fb, impl, seg, fuse, edge_impl="fused")))
+        _emit_bench(measure(fb, impl, seg, fuse, edge_impl="fused"))
         return
     if layout in ("plain", "blocked"):
-        print(json.dumps(measure(edge_block if layout == "blocked" else 0,
-                                 impl, seg, fuse)))
+        _emit_bench(measure(edge_block if layout == "blocked" else 0,
+                            impl, seg, fuse))
         return
 
     # auto: probe-gate, then measure the candidate lowerings, each in a CHILD
@@ -520,7 +538,7 @@ def main():
             rec = fail_record(f"device probe failed (wedged TPU tunnel?): {reason}")
             persist_race([], [f"probe: {reason}"], False,
                          platform="unreachable", on_hardware=False)
-            print(json.dumps(rec))
+            _emit_bench(rec)
             return
         # Claim release after a client exits takes >25 s on this tunnel; a
         # child started immediately can hang in acquire even when healthy.
@@ -679,7 +697,7 @@ def main():
             # official number — round 4 finished 4 legs and recorded nothing
             # because the only print sat after the whole race.
             if best is not None:
-                print(json.dumps(best), flush=True)
+                _emit_bench(best, flush=True)
     finally:
         _resume()
     if ambiguous:
@@ -707,7 +725,7 @@ def main():
                             f"{len(RACE_ORDER)} legs [{', '.join(measured)}]"),
                         legs_measured=measured,
                         legs_failed=[f.split(":", 1)[0] for f in fails])
-        print(json.dumps(best))
+        _emit_bench(best)
     else:
         # All children failed — almost certainly unreachable hardware (a
         # wedged axon tunnel). Do NOT fall back to an in-process measurement:
@@ -718,7 +736,7 @@ def main():
             f"all bench children died (wedged TPU tunnel?): {'; '.join(fails)}")
         rec["legs_measured"] = []
         rec["legs_failed"] = [f.split(":", 1)[0] for f in fails]
-        print(json.dumps(rec))
+        _emit_bench(rec)
 
 
 if __name__ == "__main__":

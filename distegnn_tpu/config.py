@@ -237,6 +237,22 @@ _DEFAULTS: dict = {
         "buffer_events": 256,
         "flush_interval_s": 2.0,
     },
+    # service-level objectives (distegnn_tpu/obs/slo.py): declarative
+    # thresholds scored against the event stream (obs_report --slo) or a
+    # live GET /metrics scrape (scripts/traffic_gen.py). Null thresholds
+    # declare no objective; window_s sizes the gateway's rolling-window
+    # slo/window_* gauges.
+    "slo": {
+        "enable": True,
+        "window_s": 60.0,
+        # per-route latency ceilings on SUCCESSFUL responses, e.g.
+        #   routes: {predict: {p99_ms: 250.0}, rollout: {p99_ms: 2000.0}}
+        "routes": {},
+        "error_rate_max": None,   # 5xx fraction ceiling (incl. 504)
+        "shed_rate_max": None,    # 429 fraction ceiling
+        "batch_fill_min": None,   # floor on filled/capacity slots
+        "session_hit_min": None,  # floor on session prep-cache hit rate
+    },
     "log": {
         "log_dir": "./logs",
         "test_interval": 2,
@@ -395,6 +411,18 @@ def validate_config(cfg: ConfigDict) -> None:
             raise ValueError("obs.buffer_events must be >= 1")
         if float(o.get("flush_interval_s", 2.0)) < 0:
             raise ValueError("obs.flush_interval_s must be >= 0")
+    sl = cfg.get("slo")
+    if sl is not None:
+        if not isinstance(sl.get("enable", True), bool):
+            raise ValueError("slo.enable must be a boolean")
+        from distegnn_tpu.obs.slo import SLOSpec
+
+        try:
+            # SLOSpec.from_mapping owns the threshold/route validation;
+            # surface its message under the config-section idiom
+            SLOSpec.from_mapping(dict(sl))
+        except ValueError as exc:
+            raise ValueError(str(exc)) from None
     s = cfg.get("serve")
     if s is None:
         return  # hand-built config without the serving section

@@ -11,7 +11,10 @@ One substrate for every runtime:
     these);
   - JAX-runtime probes (``obs/jaxprobe.py``): the compile watcher that
     catches recompiles-after-warmup, device memory stats, and host<->device
-    transfer byte counters.
+    transfer byte counters;
+  - declarative SLOs (``obs/slo.py``): :class:`SLOSpec` thresholds scored
+    against the event stream or a live ``GET /metrics`` scrape, plus the
+    :class:`SLOMonitor` rolling-window gauges the gateway exports.
 
 Render a run: ``python scripts/obs_report.py <log_dir>/obs/events.jsonl``.
 """
@@ -19,6 +22,7 @@ Render a run: ``python scripts/obs_report.py <log_dir>/obs/events.jsonl``.
 from distegnn_tpu.obs.metrics import (Counter, Gauge, LatencyReservoir,
                                       MetricsRegistry, REGISTRY, get_registry,
                                       percentile)
+from distegnn_tpu.obs.slo import SLOMonitor, SLOSpec
 from distegnn_tpu.obs.trace import (EventWriter, Tracer, configure,
                                     configure_from_config, event, flush,
                                     get_tracer, log, span)
@@ -28,4 +32,5 @@ __all__ = [
     "get_registry", "percentile",
     "EventWriter", "Tracer", "configure", "configure_from_config",
     "event", "flush", "get_tracer", "log", "span",
+    "SLOMonitor", "SLOSpec",
 ]

@@ -62,6 +62,10 @@ class EventWriter:
         # resumed processes reusing a dir) must not interleave with old events
         with open(path, "w"):
             pass
+        # every writer flushes at interpreter exit, not just the one the
+        # global tracer happens to hold — a bench that buffers its tail and
+        # calls sys.exit must still leave a parseable file behind
+        atexit.register(self.close)
 
     def write(self, record: Dict[str, Any]) -> None:
         line = json.dumps(record, separators=(",", ":"), default=repr)
@@ -90,6 +94,7 @@ class EventWriter:
             if not self._closed:
                 self._flush_locked()
                 self._closed = True
+        atexit.unregister(self.close)
 
 
 class _NullSpan:

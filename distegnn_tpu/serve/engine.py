@@ -40,6 +40,13 @@ from distegnn_tpu.serve.buckets import Bucket, BucketLadder
 from distegnn_tpu.serve.metrics import ServeMetrics
 
 
+def _rid_attrs(request_ids: Optional[Sequence[str]]) -> dict:
+    """serve/execute span attrs for a batch's trace ids; {} when the batch
+    carries none (in-proc callers) so untraced events stay compact."""
+    ids = [r for r in (request_ids or []) if r is not None]
+    return {"request_ids": ids} if ids else {}
+
+
 class RolloutOverflowError(RuntimeError):
     """A rollout step overflowed the static radius-graph capacity bounds
     (max_per_cell / max_degree) — results would silently drop edges."""
@@ -151,9 +158,13 @@ class InferenceEngine:
         return jitted
 
     def predict_batch(self, graphs: Sequence[dict],
-                      bucket: Optional[Bucket] = None) -> List[np.ndarray]:
+                      bucket: Optional[Bucket] = None,
+                      request_ids: Optional[Sequence[str]] = None,
+                      ) -> List[np.ndarray]:
         """Run one model step over same-bucket graphs; returns the UNPADDED
-        per-graph predicted positions ``[n_i, 3]`` (numpy, host-synced)."""
+        per-graph predicted positions ``[n_i, 3]`` (numpy, host-synced).
+        ``request_ids`` (gateway trace ids, position-aligned with ``graphs``)
+        are stamped on the ``serve/execute`` span."""
         if not graphs:
             return []
         if len(graphs) > self.max_batch:
@@ -174,7 +185,8 @@ class InferenceEngine:
                              batch.edge_block, rpad, self.max_batch),
                             lambda: self._build_predict(bucket))
         with obs.span("serve/execute", n=batch.max_nodes, e=batch.max_edges,
-                      filled=n_real, capacity=self.max_batch):
+                      filled=n_real, capacity=self.max_batch,
+                      **_rid_attrs(request_ids)):
             x = np.asarray(fn(self.params, batch))       # [max_batch, N, 3]
         return [x[i, : graphs[i]["loc"].shape[0]].copy()
                 for i in range(n_real)]
@@ -278,7 +290,9 @@ class InferenceEngine:
                 f"max_degree/max_per_cell in rollout_opts")
         return np.asarray(traj)[:, :n]
 
-    def rollout_batch(self, scenes: Sequence[dict]) -> List[np.ndarray]:
+    def rollout_batch(self, scenes: Sequence[dict],
+                      request_ids: Optional[Sequence[str]] = None,
+                      ) -> List[np.ndarray]:
         """Batched K-step rollout over same-rung scenes.
 
         Each scene dict carries ``loc`` [n, 3], ``vel`` [n, 3], ``steps``
@@ -325,7 +339,8 @@ class InferenceEngine:
 
         fn = self._compiled(("rollout_batch", n_pad, steps, B), build)
         with obs.span("serve/execute", n=n_pad, e=0, filled=len(scenes),
-                      capacity=B, workload="rollout", steps=steps):
+                      capacity=B, workload="rollout", steps=steps,
+                      **_rid_attrs(request_ids)):
             traj, over = fn(self.params, jnp.asarray(loc_p),
                             jnp.asarray(vel_p), jnp.asarray(mask))
             traj = np.asarray(traj)                      # [B, steps, n_pad, 3]

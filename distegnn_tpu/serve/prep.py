@@ -40,11 +40,13 @@ from __future__ import annotations
 
 import hashlib
 import threading
+import time
 from collections import OrderedDict
 from typing import NamedTuple, Optional
 
 import numpy as np
 
+from distegnn_tpu import obs
 from distegnn_tpu.ops.blocked import (RepackPlan, max_block_degree,
                                       repack_blocked)
 from distegnn_tpu.serve.buckets import Bucket, BucketLadder
@@ -180,9 +182,13 @@ class SessionPrepCache:
         return g
 
     # ---- the entry point -------------------------------------------------
-    def prepare(self, session_id: str, graph: dict) -> PrepResult:
+    def prepare(self, session_id: str, graph: dict,
+                request_id: Optional[str] = None) -> PrepResult:
         """Lay out ``graph`` for the serve path, reusing the session's plan
-        when its topology fingerprint still matches."""
+        when its topology fingerprint still matches. ``request_id`` (the
+        gateway's trace id) tags the ``serve/prep`` event so the waterfall
+        stitcher sees the prep leg of a traced request."""
+        t0 = time.perf_counter()
         fp = topology_fingerprint(graph["edge_index"], graph["loc"].shape[0])
         with self._lock:
             plan = self._plans.get(session_id)
@@ -206,5 +212,9 @@ class SessionPrepCache:
             hit = False
         if self.metrics is not None:
             self.metrics.session_event(hit=hit, evicted=evicted)
-        return PrepResult(graph=self._apply(graph, plan), bucket=plan.bucket,
-                          perm=plan.perm, hit=hit)
+        result = PrepResult(graph=self._apply(graph, plan),
+                            bucket=plan.bucket, perm=plan.perm, hit=hit)
+        attrs = {"request_id": request_id} if request_id is not None else {}
+        obs.event("serve/prep", session=str(session_id), hit=hit,
+                  dur_s=round(time.perf_counter() - t0, 6), **attrs)
+        return result

@@ -98,11 +98,12 @@ class ServeFuture:
 
 class _Request:
     __slots__ = ("graph", "bucket", "kind", "steps", "future", "t_submit",
-                 "deadline")
+                 "deadline", "request_id")
 
     def __init__(self, graph: dict, bucket: Bucket, deadline: float,
                  hard_deadline: Optional[float] = None,
-                 kind: str = "predict", steps: Optional[int] = None):
+                 kind: str = "predict", steps: Optional[int] = None,
+                 request_id: Optional[str] = None):
         self.graph = graph
         self.bucket = bucket
         self.kind = kind        # "predict" | "rollout"
@@ -110,6 +111,7 @@ class _Request:
         self.future = ServeFuture(hard_deadline=hard_deadline)
         self.t_submit = time.perf_counter()
         self.deadline = deadline
+        self.request_id = request_id  # gateway trace id (None off-gateway)
 
     @property
     def key(self):
@@ -117,6 +119,15 @@ class _Request:
         before; rollouts additionally key on steps (the compiled scan length)
         so mixed-K scenes never co-batch."""
         return (self.kind, self.bucket, self.steps)
+
+
+def _request_ids(reqs: List["_Request"]) -> List[Optional[str]]:
+    """Trace ids for a micro-batch, POSITION-ALIGNED with the batch members
+    (so the i-th queue_ms in the batch event belongs to the i-th id). All
+    non-gateway traffic (in-proc bench submits) has no ids: return [] so
+    those events stay compact."""
+    ids = [r.request_id for r in reqs]
+    return ids if any(i is not None for i in ids) else []
 
 
 _STOP = object()
@@ -229,13 +240,15 @@ class RequestQueue:
         self.stop()
 
     # ---- submission ------------------------------------------------------
-    def submit(self, graph: dict,
-               bucket: Optional[Bucket] = None) -> ServeFuture:
+    def submit(self, graph: dict, bucket: Optional[Bucket] = None,
+               request_id: Optional[str] = None) -> ServeFuture:
         """Admit one pad_graphs-style graph dict; returns a ServeFuture
         resolving to the predicted positions [n, 3] (numpy). ``bucket``
         overrides the ladder assignment — the session prep cache passes the
         rung it computed from the RAW topology, since a prepared (blocked)
-        dict's inflated edge count would otherwise re-bucket it."""
+        dict's inflated edge count would otherwise re-bucket it.
+        ``request_id`` tags the request's batch/execute spans in the event
+        stream (the gateway passes its X-Request-Id)."""
         if not self._started:
             raise RuntimeError("RequestQueue not started (use start() or a "
                                "with-block)")
@@ -244,10 +257,12 @@ class RequestQueue:
         now = time.perf_counter()
         req = _Request(graph, bucket, deadline=now + self.request_timeout,
                        hard_deadline=(now + self.request_timeout
-                                      + self.result_margin))
+                                      + self.result_margin),
+                       request_id=request_id)
         return self._enqueue(req)
 
-    def submit_rollout(self, scene: dict) -> ServeFuture:
+    def submit_rollout(self, scene: dict,
+                       request_id: Optional[str] = None) -> ServeFuture:
         """Admit one rollout scene dict (``loc`` [n,3], ``vel`` [n,3],
         ``steps`` int, optional ``node_mask``); resolves to the trajectory
         [steps, n, 3]. Same deadline/backpressure semantics as ``submit`` —
@@ -266,7 +281,7 @@ class RequestQueue:
                        deadline=now + self.request_timeout,
                        hard_deadline=(now + self.request_timeout
                                       + self.result_margin),
-                       kind="rollout", steps=steps)
+                       kind="rollout", steps=steps, request_id=request_id)
         return self._enqueue(req)
 
     def _enqueue(self, req: _Request) -> ServeFuture:
@@ -375,18 +390,21 @@ class RequestQueue:
                     f"in bucket {key[1]}"))
         reqs[:] = alive
 
-    def _run_batch(self, key, graphs: List[dict]) -> List:
+    def _run_batch(self, key, reqs: List[_Request]) -> List:
         """One engine call for a coalesced micro-batch; dispatch on kind."""
         kind, bucket, _steps = key
+        graphs = [r.graph for r in reqs]
+        rids = _request_ids(reqs)
         if kind == "rollout":
-            return self.engine.rollout_batch(graphs)
-        return self.engine.predict_batch(graphs, bucket=bucket)
+            return self.engine.rollout_batch(graphs, request_ids=rids)
+        return self.engine.predict_batch(graphs, bucket=bucket,
+                                         request_ids=rids)
 
     def _execute(self, key, reqs: List[_Request]) -> None:
         kind, bucket, steps = key
         t_start = time.perf_counter()
         try:
-            outs = self._run_batch(key, [r.graph for r in reqs])
+            outs = self._run_batch(key, reqs)
         except Exception:
             # one bad graph fails the whole padded batch — retry each request
             # ALONE once, so a poison graph only takes down itself
@@ -398,35 +416,44 @@ class RequestQueue:
         self.metrics.batch_done(len(reqs), self.engine.max_batch, lats, qms)
         obs.event("serve/batch", n=bucket.n, e=bucket.e, filled=len(reqs),
                   capacity=self.engine.max_batch, workload=kind,
-                  dur_s=round(now - t_start, 6))
+                  dur_s=round(now - t_start, 6),
+                  request_ids=_request_ids(reqs),
+                  queue_ms=[round(q, 3) for q in qms])
         compute_ms = round((now - t_start) * 1e3, 3)
         for r, out, q_ms in zip(reqs, outs, qms):
             r.future.meta.update(queue_ms=round(q_ms, 3),
                                  compute_ms=compute_ms,
                                  batch_filled=len(reqs),
-                                 bucket_n=bucket.n, bucket_e=bucket.e)
+                                 bucket_n=bucket.n, bucket_e=bucket.e,
+                                 request_id=r.request_id)
             r.future.set_result(out)
 
     def _retry_individually(self, key, reqs: List[_Request]) -> None:
-        _kind, bucket, _steps = key
+        kind, bucket, _steps = key
         self.metrics.retried(len(reqs))
         for r in reqs:
             t_start = time.perf_counter()
             try:
-                out = self._run_batch(key, [r.graph])[0]
+                out = self._run_batch(key, [r])[0]
             except Exception as solo_exc:  # fails even alone: the poison graph
                 self.metrics.poison()
                 self.metrics.failed()
                 r.future.set_exception(solo_exc)
                 continue
             now = time.perf_counter()
+            q_ms = (t_start - r.t_submit) * 1e3
             self.metrics.batch_done(1, self.engine.max_batch,
-                                    [(now - r.t_submit) * 1e3],
-                                    [(t_start - r.t_submit) * 1e3])
+                                    [(now - r.t_submit) * 1e3], [q_ms])
+            obs.event("serve/batch", n=bucket.n, e=bucket.e, filled=1,
+                      capacity=self.engine.max_batch, workload=kind,
+                      dur_s=round(now - t_start, 6), retry=True,
+                      request_ids=_request_ids([r]),
+                      queue_ms=[round(q_ms, 3)])
             r.future.meta.update(
-                queue_ms=round((t_start - r.t_submit) * 1e3, 3),
+                queue_ms=round(q_ms, 3),
                 compute_ms=round((now - t_start) * 1e3, 3),
-                batch_filled=1, bucket_n=bucket.n, bucket_e=bucket.e)
+                batch_filled=1, bucket_n=bucket.n, bucket_e=bucket.e,
+                request_id=r.request_id)
             r.future.set_result(out)
 
     def _fail_all(self, exc: BaseException) -> None:

@@ -77,6 +77,9 @@ class ModelEntry:
                        "max_edges": self.engine.ladder.max_edges},
             "queue_depth": self.queue.depth(),
             "requests_completed": snap["requests_completed"],
+            # clients (scripts/traffic_gen.py) read this to know whether
+            # rollout traffic is servable or would 501
+            "rollout": bool(getattr(self.engine, "_rollout_opts", None)),
         }
 
 

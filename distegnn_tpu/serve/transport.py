@@ -47,6 +47,7 @@ import json
 import signal
 import threading
 import time
+import uuid
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional, Tuple
 
@@ -62,6 +63,22 @@ from distegnn_tpu.serve.registry import ModelRegistry
 
 class PayloadError(ValueError):
     """Malformed request body — the transport's 400."""
+
+
+_RID_MAX_LEN = 64
+
+
+def mint_request_id(supplied: Optional[str] = None) -> str:
+    """Return the request id for one HTTP request: the client's
+    ``X-Request-Id`` when it is a sane token, else a fresh one. Client ids
+    are clamped to printable non-space ASCII so they can round-trip through
+    headers and the JSONL event stream unescaped."""
+    if supplied:
+        rid = "".join(c for c in str(supplied).strip()
+                      if c.isprintable() and not c.isspace())
+        if rid:
+            return rid[:_RID_MAX_LEN]
+    return uuid.uuid4().hex[:16]
 
 
 # ---- payload <-> graph dict -------------------------------------------------
@@ -246,11 +263,17 @@ class Gateway:
     def __init__(self, registry: ModelRegistry, *, host: str = "127.0.0.1",
                  port: int = 0, max_inflight: int = 64,
                  drain_grace_s: float = 10.0,
-                 metrics_registry: Optional[MetricsRegistry] = None):
+                 metrics_registry: Optional[MetricsRegistry] = None,
+                 slo_window_s: float = 60.0):
+        from distegnn_tpu.obs.slo import SLOMonitor
+
         self.registry = registry
         self.max_inflight = int(max_inflight)
         self.drain_grace_s = float(drain_grace_s)
         self._reg = metrics_registry or obs.get_registry()
+        # rolling-window SLO gauges (slo/window_*): fed per inference
+        # request, exported on every GET /metrics render
+        self.slo_monitor = SLOMonitor(window_s=slo_window_s)
         self._c = {n: self._reg.counter("gateway/" + n)
                    for n in _GATEWAY_COUNTERS}
         self._inflight_gauge = self._reg.gauge("gateway/inflight")
@@ -333,9 +356,14 @@ class Gateway:
     def dispatch(self, handler, method: str) -> None:
         path = handler.path.split("?", 1)[0]
         route = self._route_name(method, path)
+        # every request gets an id at the edge: echoed back as X-Request-Id
+        # and attached to every span/event the request touches downstream
+        rid = mint_request_id(handler.headers.get("X-Request-Id"))
+        handler.request_id = rid
         self._c["requests_total"].add(1)
         t0 = time.perf_counter()
-        with obs.span("serve/http", route=route, method=method) as sp:
+        with obs.span("serve/http", route=route, method=method,
+                      request_id=rid) as sp:
             try:
                 status = self._handle(handler, method, path, route)
             except PayloadError as exc:
@@ -351,8 +379,9 @@ class Gateway:
                 status = self._send_json(handler, 500, {
                     "error": repr(exc), "type": type(exc).__name__})
             sp.set(status=status)
-        self._reg.reservoir(f"gateway/http_{route}_ms").record(
-            (time.perf_counter() - t0) * 1e3)
+        ms = (time.perf_counter() - t0) * 1e3
+        self._reg.reservoir(f"gateway/http_{route}_ms").record(ms)
+        self.slo_monitor.observe_http(route, ms, status)
 
     def _handle(self, h, method: str, path: str, route: str) -> int:
         if route in ("predict", "rollout"):
@@ -432,17 +461,19 @@ class Gateway:
         if encoding not in ("list", "b64"):
             raise PayloadError("'encoding' must be 'list' or 'b64'")
         t0 = time.perf_counter()
+        rid = getattr(h, "request_id", None)
         session = None
         bucket = perm = None
         session_id = payload.get("session_id")
         cache = getattr(entry.engine, "prep_cache", None)
         if session_id is not None and cache is not None:
-            prepped = cache.prepare(str(session_id), graph)
+            prepped = cache.prepare(str(session_id), graph, request_id=rid)
             graph, bucket, perm = prepped.graph, prepped.bucket, prepped.perm
             session = {"id": str(session_id), "hit": prepped.hit,
                        "prep_ms": round((time.perf_counter() - t0) * 1e3, 3)}
         fut, status = self._submit_guarded(
-            h, lambda: entry.queue.submit(graph, bucket=bucket))
+            h, lambda: entry.queue.submit(graph, bucket=bucket,
+                                          request_id=rid))
         if fut is None:
             return status
         try:
@@ -460,6 +491,7 @@ class Gateway:
         meta = dict(fut.meta)
         self._c["predict_ok"].add(1)
         body = {
+            "request_id": rid,
             "model": name,
             "n": int(graph["loc"].shape[0]),
             "prediction": encode_array(out, encoding),
@@ -485,8 +517,9 @@ class Gateway:
         if encoding not in ("list", "b64"):
             raise PayloadError("'encoding' must be 'list' or 'b64'")
         t0 = time.perf_counter()
+        rid = getattr(h, "request_id", None)
         fut, status = self._submit_guarded(
-            h, lambda: entry.queue.submit_rollout(scene))
+            h, lambda: entry.queue.submit_rollout(scene, request_id=rid))
         if fut is None:
             return status
         try:
@@ -504,6 +537,7 @@ class Gateway:
         meta = dict(fut.meta)
         self._c["rollout_ok"].add(1)
         return self._send_json(h, 200, {
+            "request_id": rid,
             "model": name,
             "n": int(scene["loc"].shape[0]),
             "steps": int(scene["steps"]),
@@ -523,6 +557,7 @@ class Gateway:
         with self._inflight_lock:
             self._inflight_gauge.set(self._inflight)
         self._ready_gauge.set(1.0 if self.ready() else 0.0)
+        self.slo_monitor.export(self._reg, self.registry)
         parts = [self._reg.render_prometheus(prefix="distegnn")]
         for name, entry in self.registry.items():
             parts.append(entry.engine.metrics.registry.render_prometheus(
@@ -562,6 +597,9 @@ class Gateway:
         h.send_response(status)
         h.send_header("Content-Type", content_type)
         h.send_header("Content-Length", str(len(body)))
+        rid = getattr(h, "request_id", None)
+        if rid is not None:
+            h.send_header("X-Request-Id", rid)
         h.end_headers()
         h.wfile.write(body)
         return status

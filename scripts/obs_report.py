@@ -3,16 +3,26 @@
 
 Usage:
   python scripts/obs_report.py <events.jsonl> [--json] [--check]
+                               [--request <id>] [--slo <spec.yaml>]
 
-  --json    emit the summary dict as one JSON object instead of text
-  --check   CI gate: exit 1 if the stream has ZERO events (telemetry dead)
-            or ANY recompile after warmup (the silent shape-ladder bug);
-            failures are printed to stderr after the report
+  --json         emit the summary dict as one JSON object instead of text
+  --check        CI gate: exit 1 if the stream has ZERO events (telemetry
+                 dead) or ANY recompile after warmup (the silent
+                 shape-ladder bug); failures are printed to stderr
+  --request ID   render the queue -> batch -> compute waterfall for one
+                 gateway request id instead of the run report; exit 1 when
+                 the id is absent from the stream (with --json: the
+                 stitched dict)
+  --slo SPEC     evaluate an SLO spec (a YAML/JSON file: the `slo:` config
+                 section, or a full config containing one) against the
+                 event stream; renders the verdict table and exits 1 on
+                 any breach (with --json: the results dict)
 
 The heavy lifting lives in distegnn_tpu.obs.report (pure functions over
 parsed events) so tests drive it without a subprocess. Typical sources:
   <log_dir>/<exp_name>/obs/events.jsonl    (training, process 0)
   logs/serve_bench/obs/events.jsonl        (scripts/serve_bench.py)
+  logs/traffic_gen/obs/events.jsonl        (scripts/traffic_gen.py)
 """
 
 from __future__ import annotations
@@ -24,7 +34,40 @@ import sys
 
 sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
 
-from distegnn_tpu.obs.report import check, load_events, render_text, summarize
+from distegnn_tpu.obs.report import (check, load_events, render_request,
+                                     render_text, request_ids_seen,
+                                     stitch_request, summarize)
+
+
+def _report_request(events, rid: str, source: str, as_json: bool) -> int:
+    stitched = stitch_request(events, rid)
+    if not stitched["records"]:
+        known = request_ids_seen(events)
+        print(f"obs_report: request {rid!r} not found in {source} "
+              f"({len(known)} id(s) present"
+              + (f", e.g. {known[0]!r}" if known else "") + ")",
+              file=sys.stderr)
+        return 1
+    if as_json:
+        print(json.dumps(stitched, sort_keys=True, default=str))
+    else:
+        print(render_request(stitched, source=source), end="")
+    return 0
+
+
+def _report_slo(events, spec_path: str, source: str, as_json: bool) -> int:
+    from distegnn_tpu.obs import slo as slomod
+
+    if not os.path.exists(spec_path):
+        print(f"obs_report: no such SLO spec: {spec_path}", file=sys.stderr)
+        return 2
+    spec = slomod.SLOSpec.from_file(spec_path)
+    results = slomod.evaluate(spec, slomod.stats_from_events(events))
+    if as_json:
+        print(json.dumps(slomod.results_json(results), sort_keys=True))
+    else:
+        print(slomod.verdict_table(results, source=source), end="")
+    return 1 if slomod.breached(results) else 0
 
 
 def main(argv=None) -> int:
@@ -34,12 +77,25 @@ def main(argv=None) -> int:
                     help="emit the summary as JSON instead of text")
     ap.add_argument("--check", action="store_true",
                     help="exit 1 on zero events or recompiles after warmup")
+    ap.add_argument("--request", metavar="ID", default=None,
+                    help="render one request's waterfall instead of the "
+                         "run report")
+    ap.add_argument("--slo", metavar="SPEC", default=None,
+                    help="evaluate an SLO spec file against the stream; "
+                         "exit 1 on breach")
     args = ap.parse_args(argv)
 
     if not os.path.exists(args.events):
         print(f"obs_report: no such file: {args.events}", file=sys.stderr)
         return 2
     events, bad = load_events(args.events)
+
+    if args.request is not None:
+        return _report_request(events, args.request, args.events,
+                               args.as_json)
+    if args.slo is not None:
+        return _report_slo(events, args.slo, args.events, args.as_json)
+
     summary = summarize(events)
     if args.as_json:
         print(json.dumps({**summary, "bad_lines": bad}, sort_keys=True))

@@ -307,6 +307,7 @@ def main(argv=None) -> int:
             "snapshot": snap,
         }
         print(json.dumps(rec, sort_keys=True))
+        obs.event("bench/result", **rec)
         tracer = obs.get_tracer()
         tracer.flush()
         w = getattr(tracer, "writer", None)
@@ -365,6 +366,7 @@ def main(argv=None) -> int:
         "snapshot": snap,
     }
     print(json.dumps(rec, sort_keys=True))
+    obs.event("bench/result", **rec)
 
     tracer = obs.get_tracer()
     tracer.flush()

@@ -83,7 +83,9 @@ def main(argv=None) -> int:
         port=args.port if args.port is not None else int(g.port),
         max_inflight=(args.max_inflight if args.max_inflight is not None
                       else int(g.max_inflight)),
-        drain_grace_s=float(g.drain_grace_s))
+        drain_grace_s=float(g.drain_grace_s),
+        slo_window_s=float((cfg.get("slo") or {}).get("window_s", 60.0)
+                           or 60.0))
     gateway.install_signal_handlers()
     host, port = gateway.address
     obs.log(f"gateway: listening on http://{host}:{port} "

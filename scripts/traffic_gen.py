@@ -1,0 +1,507 @@
+"""Mixed-traffic replay harness: open-loop predict/session/rollout load
+against a live gateway socket.
+
+Replays a configurable traffic mix (``--mix predict=0.6,session=0.3,
+rollout=0.1``) across every served model, with heavy-tailed graph sizes
+drawn from the shape ladder (``--sizes`` is the rung support; rung k is
+picked with weight 1/(k+1)^--tail, so most traffic is small and the tail
+is large) and BURSTY arrivals: a Poisson process (mean ``--rate`` req/s)
+gated by an on/off modulator (exponential ON phases of mean
+``--burst-on-s`` separated by exponential OFF gaps of mean
+``--burst-off-s``; ``--burst-off-s 0`` degenerates to pure Poisson). The
+loop is OPEN: arrival k fires at its scheduled time regardless of
+completions, so queueing delay and shedding are measured honestly.
+
+Traffic classes:
+  predict   fresh synthetic graph per request -> POST .../predict
+  session   requests drawn from a pool of --sessions sticky ids, each
+            pinned to ONE fixed graph -> POST .../predict with
+            ``session_id`` (exercises the prep/session cache)
+  rollout   K-step scene (--rollout-steps) -> POST .../rollout; routed
+            only to rollout-capable models (folded into predict, with a
+            stderr note, when none is)
+
+Every request carries ``X-Request-Id: tg-<seed>-<k>`` and records the
+echoed id, so any request in the run can be replayed as a waterfall:
+``python scripts/obs_report.py <events> --request tg-<seed>-<k>``.
+
+Target: ``--url http://host:port`` drives an already-running gateway
+(models discovered via GET /v1/models); without ``--url`` the script
+boots an in-process gateway from ``--config_path`` (default built-ins)
+on an ephemeral port and still drives it over the real socket.
+
+Stdout is EXACTLY one BENCH JSON line:
+
+  {"metric": "traffic_p99_ms", "value": <overall p99>, "unit": "ms",
+   "classes": {<class>: {count, ok, p50_ms, p99_ms}}, "throughput_rps":
+   ..., "shed": <429 fraction>, "batch_fill": ..., "slo": {<verdict>}}
+
+plus the SLO verdict table on stderr (spec from ``--slo <file>``, else
+the config's ``slo:`` section). A breach is REPORTED, not fatal — the
+exit code is 0 iff any request completed; gate on the verdict with
+``obs_report.py --slo``. The run's event stream lands at
+``--obs-dir/obs/events.jsonl`` (default logs/traffic_gen/).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import random
+import sys
+import threading
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+CLASSES = ("predict", "session", "rollout")
+
+
+# ---- plan construction ------------------------------------------------------
+
+def parse_mix(spec: str) -> dict:
+    """'predict=0.6,session=0.3,rollout=0.1' -> normalized class weights."""
+    mix = {}
+    for part in spec.split(","):
+        part = part.strip()
+        if not part:
+            continue
+        name, _, val = part.partition("=")
+        name = name.strip()
+        if name not in CLASSES:
+            raise ValueError(f"unknown traffic class {name!r} "
+                             f"(known: {', '.join(CLASSES)})")
+        try:
+            mix[name] = float(val)
+        except ValueError:
+            raise ValueError(f"bad mix weight for {name!r}: {val!r}") from None
+        if mix[name] < 0:
+            raise ValueError(f"mix weight for {name!r} must be >= 0")
+    total = sum(mix.values())
+    if total <= 0:
+        raise ValueError(f"traffic mix {spec!r} has no positive weight")
+    return {k: mix.get(k, 0.0) / total for k in CLASSES}
+
+
+def size_sampler(sizes, alpha: float, rng: random.Random):
+    """Heavy-tailed draw over ascending ladder sizes: rung k gets weight
+    1/(k+1)^alpha — most traffic at the floor, a power-law tail of big
+    graphs."""
+    sizes = sorted(set(int(s) for s in sizes))
+    weights = [1.0 / (k + 1) ** alpha for k in range(len(sizes))]
+    return lambda: rng.choices(sizes, weights=weights, k=1)[0]
+
+
+def arrival_times(n: int, rate: float, on_s: float, off_s: float,
+                  rng: random.Random):
+    """n arrival offsets (seconds from t0): Poisson at ``rate`` during
+    exponential ON phases (mean on_s), jumping exponential OFF gaps (mean
+    off_s). off_s <= 0 -> a pure Poisson process."""
+    out, t = [], 0.0
+    on_left = rng.expovariate(1.0 / on_s) if off_s > 0 else float("inf")
+    for _ in range(n):
+        dt = rng.expovariate(rate)
+        while off_s > 0 and dt > on_left:
+            dt -= on_left
+            t += on_left + rng.expovariate(1.0 / off_s)  # jump the OFF gap
+            on_left = rng.expovariate(1.0 / on_s)
+        on_left -= dt
+        t += dt
+        out.append(t)
+    return out
+
+
+def _b64_field(a, dtype):
+    import base64
+
+    import numpy as np
+
+    a = np.ascontiguousarray(a, dtype=dtype)
+    return {"b64": base64.b64encode(a.tobytes()).decode("ascii"),
+            "shape": list(a.shape)}
+
+
+def predict_payload(g, session_id=None) -> bytes:
+    body = {
+        "positions": _b64_field(g["loc"], "<f4"),
+        "velocities": _b64_field(g["vel"], "<f4"),
+        "node_feat": _b64_field(g["node_feat"], "<f4"),
+        "edge_attr": _b64_field(g["edge_attr"], "<f4"),
+        "edge_index": _b64_field(g["edge_index"], "<i4"),
+        "encoding": "b64",
+    }
+    if session_id is not None:
+        body["session_id"] = str(session_id)
+    return json.dumps(body).encode()
+
+
+def rollout_payload(g, steps: int) -> bytes:
+    return json.dumps({
+        "positions": _b64_field(g["loc"], "<f4"),
+        "velocities": _b64_field(g["vel"], "<f4"),
+        "steps": int(steps),
+        "encoding": "b64",
+    }).encode()
+
+
+def build_plan(args, models, rollout_models, feat_nf, edge_attr_nf):
+    """The full replay plan, deterministic under --seed: a list of
+    ``{cls, model, path, body, rid}`` plus the arrival offsets."""
+    from distegnn_tpu.serve.buckets import synthetic_graph
+
+    rng = random.Random(args.seed)
+    mix = parse_mix(args.mix)
+    if mix["rollout"] > 0 and not rollout_models:
+        print("traffic_gen: no rollout-capable model; folding the rollout "
+              "share into predict", file=sys.stderr)  # noqa: obs-print
+        mix["predict"] += mix["rollout"]
+        mix["rollout"] = 0.0
+    draw_size = size_sampler(args.size_list, args.tail, rng)
+
+    # session pool: sticky id -> ONE fixed graph (same bytes every time, so
+    # the prep cache's plan-reuse path is actually exercised)
+    sessions = []
+    for i in range(max(1, args.sessions)):
+        n = draw_size()
+        g = synthetic_graph(n, seed=10_000 + args.seed + i, feat_nf=feat_nf,
+                            edge_attr_nf=edge_attr_nf)
+        sessions.append((f"tg-sess-{i}", predict_payload(
+            g, session_id=f"tg-sess-{i}")))
+
+    names, weights = zip(*sorted(mix.items()))
+    plan = []
+    for k in range(args.requests):
+        cls = rng.choices(names, weights=weights, k=1)[0]
+        rid = f"tg-{args.seed}-{k}"
+        if cls == "rollout":
+            model = rng.choice(rollout_models)
+            g = synthetic_graph(draw_size(), seed=args.seed + k,
+                                feat_nf=feat_nf, edge_attr_nf=edge_attr_nf)
+            body = rollout_payload(g, args.rollout_steps)
+            path = f"/v1/models/{model}/rollout"
+        elif cls == "session":
+            model = rng.choice(models)
+            _, body = sessions[rng.randrange(len(sessions))]
+            path = f"/v1/models/{model}/predict"
+        else:
+            model = rng.choice(models)
+            g = synthetic_graph(draw_size(), seed=args.seed + k,
+                                feat_nf=feat_nf, edge_attr_nf=edge_attr_nf)
+            body = predict_payload(g)
+            path = f"/v1/models/{model}/predict"
+        plan.append({"cls": cls, "model": model, "path": path, "body": body,
+                     "rid": rid})
+    offsets = arrival_times(args.requests, args.rate, args.burst_on_s,
+                            args.burst_off_s, rng)
+    return plan, offsets
+
+
+# ---- target gateways --------------------------------------------------------
+
+def discover_models(base_url: str, timeout: float = 10.0):
+    """(all model names, rollout-capable names) from GET /v1/models."""
+    import urllib.request
+
+    with urllib.request.urlopen(base_url.rstrip("/") + "/v1/models",
+                                timeout=timeout) as resp:
+        desc = json.loads(resp.read().decode())
+    models = [m["name"] for m in desc.get("models", [])]
+    rollout = [m["name"] for m in desc.get("models", [])
+               if m.get("rollout")]
+    return models, rollout
+
+
+def boot_gateway(args, cfg):
+    """In-process gateway from the config, on an ephemeral port; returns
+    (gateway, server_thread, registry)."""
+    from distegnn_tpu.obs import jaxprobe
+    from distegnn_tpu.serve.registry import ModelRegistry
+    from distegnn_tpu.serve.transport import Gateway
+
+    mix = parse_mix(args.mix)
+    if mix["rollout"] > 0 and not cfg.serve.get("rollout"):
+        # same geometry defaults as serve_bench's rollout workload
+        cfg.serve.rollout = {"radius": 0.35, "max_degree": 96,
+                             "max_per_cell": 128, "edge_block": 256}
+    if mix["rollout"] > 0:
+        # K-step CPU batches take seconds; a serving-tuned 1 s request
+        # timeout would shed every queued scene and bench the timeout path
+        cfg.serve.request_timeout_ms = max(
+            float(cfg.serve.request_timeout_ms), 600_000.0)
+    if args.max_batch is not None:
+        cfg.serve.max_batch = int(args.max_batch)
+
+    registry = ModelRegistry.from_config(cfg).start()
+    registry.warmup(args.size_list)
+    jaxprobe.mark_warmup_done()
+    slo_window = float((cfg.get("slo") or {}).get("window_s", 60.0) or 60.0)
+    gw = Gateway(registry, port=0,
+                 max_inflight=max(64, args.requests),
+                 slo_window_s=slo_window)
+    server = threading.Thread(target=gw.serve_forever, name="tg-gateway",
+                              daemon=True)
+    server.start()
+    return gw, server, registry
+
+
+# ---- replay -----------------------------------------------------------------
+
+def replay(base_url: str, plan, offsets, timeout_s: float):
+    """Fire the plan open-loop; returns per-request result dicts
+    ``{cls, status, ms, rid}`` (status -1 = transport error) and wall_s."""
+    import urllib.error
+    import urllib.request
+
+    results = [None] * len(plan)
+
+    def post(i, item):
+        req = urllib.request.Request(
+            base_url.rstrip("/") + item["path"], data=item["body"],
+            headers={"Content-Type": "application/json",
+                     "X-Request-Id": item["rid"]},
+            method="POST")
+        t_req = time.perf_counter()
+        status, echoed = -1, None
+        try:
+            with urllib.request.urlopen(req, timeout=timeout_s) as resp:
+                status = int(resp.status)
+                echoed = resp.headers.get("X-Request-Id")
+        except urllib.error.HTTPError as e:
+            status = int(e.code)
+            echoed = e.headers.get("X-Request-Id")
+        except Exception:
+            pass
+        results[i] = {"cls": item["cls"], "status": status,
+                      "ms": (time.perf_counter() - t_req) * 1e3,
+                      "rid": echoed or item["rid"]}
+
+    threads = []
+    t0 = time.perf_counter()
+    for k, (item, off) in enumerate(zip(plan, offsets)):
+        delay = (t0 + off) - time.perf_counter()
+        if delay > 0:
+            time.sleep(delay)
+        t = threading.Thread(target=post, args=(k, item), daemon=True)
+        t.start()
+        threads.append(t)
+    for t in threads:
+        t.join(timeout=timeout_s + 60.0)
+    wall = time.perf_counter() - t0
+    for i, item in enumerate(plan):   # a thread that never returned = error
+        if results[i] is None:
+            results[i] = {"cls": item["cls"], "status": -1,
+                          "ms": timeout_s * 1e3, "rid": item["rid"]}
+    return results, wall
+
+
+def scrape_metrics(base_url: str, timeout: float = 10.0) -> str:
+    import urllib.request
+
+    try:
+        with urllib.request.urlopen(base_url.rstrip("/") + "/metrics",
+                                    timeout=timeout) as resp:
+            return resp.read().decode()
+    except Exception:
+        return ""
+
+
+# ---- scoring ----------------------------------------------------------------
+
+def class_stats(results):
+    """Per-class {count, ok, p50_ms, p99_ms} + the overall p50/p99 over
+    successful requests."""
+    from distegnn_tpu.obs.metrics import percentile
+
+    classes = {}
+    ok_all = []
+    for cls in CLASSES:
+        rows = [r for r in results if r["cls"] == cls]
+        if not rows:
+            continue
+        ok = sorted(r["ms"] for r in rows if 200 <= r["status"] < 400)
+        ok_all.extend(ok)
+        classes[cls] = {
+            "count": len(rows),
+            "ok": len(ok),
+            "p50_ms": round(percentile(ok, 50), 3) if ok else None,
+            "p99_ms": round(percentile(ok, 99), 3) if ok else None,
+        }
+    ok_all.sort()
+    p50 = round(percentile(ok_all, 50), 3) if ok_all else None
+    p99 = round(percentile(ok_all, 99), 3) if ok_all else None
+    return classes, p50, p99
+
+
+def slo_stats(results, prom_text: str):
+    """Client-observed SLO stats vocabulary, merged with the scrape's
+    server-side fill/session stats (the client can't see slot counters)."""
+    from distegnn_tpu.obs import slo as slomod
+    from distegnn_tpu.obs.metrics import percentile
+
+    stats = {}
+    # session requests ride the predict route; score them together
+    by_route = {"predict": [r for r in results
+                            if r["cls"] in ("predict", "session")],
+                "rollout": [r for r in results if r["cls"] == "rollout"]}
+    for route, rows in by_route.items():
+        ok = sorted(r["ms"] for r in rows if 200 <= r["status"] < 400)
+        if ok:
+            stats[f"{route}_p50_ms"] = round(percentile(ok, 50), 3)
+            stats[f"{route}_p99_ms"] = round(percentile(ok, 99), 3)
+    if results:
+        stats["error_rate"] = round(
+            sum(1 for r in results if r["status"] >= 500
+                or r["status"] < 0) / len(results), 6)
+        stats["shed_rate"] = round(
+            sum(1 for r in results if r["status"] == 429) / len(results), 6)
+    scraped = slomod.stats_from_prometheus(prom_text) if prom_text else {}
+    for key in ("batch_fill", "session_hit_rate"):
+        if key in scraped:
+            stats[key] = scraped[key]
+    return stats
+
+
+def load_slo_spec(args, cfg):
+    from distegnn_tpu.obs import slo as slomod
+
+    if args.slo:
+        return slomod.SLOSpec.from_file(args.slo)
+    sl = cfg.get("slo") if cfg is not None else None
+    if sl and sl.get("enable", True):
+        return slomod.SLOSpec.from_mapping(dict(sl))
+    return slomod.SLOSpec()
+
+
+# ---- entry ------------------------------------------------------------------
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="mixed-traffic open-loop replay against a live gateway")
+    ap.add_argument("--url", type=str, default=None,
+                    help="base URL of a running gateway (default: boot an "
+                         "in-process one and drive it over its socket)")
+    ap.add_argument("--config_path", type=str, default=None,
+                    help="YAML config for the in-process gateway / SLO spec")
+    ap.add_argument("--requests", type=int, default=48)
+    ap.add_argument("--rate", type=float, default=100.0,
+                    help="mean arrival rate during ON phases, req/s")
+    ap.add_argument("--mix", type=str,
+                    default="predict=0.6,session=0.3,rollout=0.1",
+                    help="class=weight list over predict/session/rollout")
+    ap.add_argument("--sizes", type=str, default="24,48,96,192",
+                    help="ladder-rung node counts the size tail draws from")
+    ap.add_argument("--tail", type=float, default=1.5,
+                    help="power-law exponent: rung k drawn with weight "
+                         "1/(k+1)^tail (bigger = thinner tail)")
+    ap.add_argument("--burst-on-s", type=float, default=0.5,
+                    help="mean length of an ON burst, seconds")
+    ap.add_argument("--burst-off-s", type=float, default=0.2,
+                    help="mean OFF gap between bursts; 0 = pure Poisson")
+    ap.add_argument("--sessions", type=int, default=4,
+                    help="sticky session-id pool size for the session class")
+    ap.add_argument("--rollout-steps", type=int, default=4)
+    ap.add_argument("--seed", type=int, default=47)
+    ap.add_argument("--timeout-s", type=float, default=300.0,
+                    help="per-request client timeout")
+    ap.add_argument("--max-batch", type=int, default=None,
+                    help="override serve.max_batch (in-process gateway only)")
+    ap.add_argument("--slo", type=str, default=None,
+                    help="SLO spec file; default: the config's slo: section")
+    ap.add_argument("--obs-dir", type=str, default="logs/traffic_gen",
+                    help="event sink dir (<dir>/obs/events.jsonl); '' off")
+    args = ap.parse_args(argv)
+    args.size_list = [int(s) for s in args.sizes.split(",") if s.strip()]
+    if not args.size_list:
+        print("traffic_gen: --sizes is empty", file=sys.stderr)  # noqa: obs-print
+        return 2
+
+    from distegnn_tpu import obs
+    from distegnn_tpu.config import ConfigDict, _DEFAULTS, load_config
+    from distegnn_tpu.obs import slo as slomod
+
+    cfg = (load_config(args.config_path) if args.config_path
+           else ConfigDict(_DEFAULTS))
+    if args.obs_dir:
+        obs.configure_from_config(cfg, args.obs_dir,
+                                  tags={"run": "traffic_gen"})
+
+    gw = server = registry = None
+    if args.url:
+        base_url = args.url
+        models, rollout_models = discover_models(base_url)
+        if not models:
+            print(f"traffic_gen: {base_url} serves no models",
+                  file=sys.stderr)  # noqa: obs-print
+            return 2
+    else:
+        gw, server, registry = boot_gateway(args, cfg)
+        base_url = gw.url("")
+        models = registry.names()
+        rollout_models = [n for n, e in registry.items()
+                          if getattr(e.engine, "_rollout_opts", None)]
+
+    feat_nf = int(cfg.model.node_feat_nf)
+    edge_attr_nf = int(cfg.model.edge_attr_nf)
+    plan, offsets = build_plan(args, models, rollout_models, feat_nf,
+                               edge_attr_nf)
+    obs.event("traffic/start", requests=args.requests, rate=args.rate,
+              mix=args.mix, sizes=args.size_list, models=models,
+              burst_on_s=args.burst_on_s, burst_off_s=args.burst_off_s,
+              target=("remote" if args.url else "inproc"))
+
+    results, wall = replay(base_url, plan, offsets, args.timeout_s)
+    prom_text = scrape_metrics(base_url)
+    if gw is not None:
+        gw.drain()
+        server.join(timeout=30.0)
+        gw.close()
+
+    classes, p50, p99 = class_stats(results)
+    completed = sum(1 for r in results if 200 <= r["status"] < 400)
+    stats = slo_stats(results, prom_text)
+    spec = load_slo_spec(args, cfg)
+    slo_results = slomod.evaluate(spec, stats)
+    print(slomod.verdict_table(slo_results, source="traffic_gen"),
+          end="", file=sys.stderr)  # noqa: obs-print
+
+    rec = {
+        "metric": "traffic_p99_ms",
+        "value": p99,
+        "unit": "ms",
+        "vs_baseline": None,
+        "p50_ms": p50,
+        "classes": classes,
+        "requests": args.requests,
+        "completed": completed,
+        "throughput_rps": round(completed / max(wall, 1e-9), 3),
+        "shed": round(sum(1 for r in results if r["status"] == 429)
+                      / max(len(results), 1), 6),
+        "errors": sum(1 for r in results if r["status"] >= 500
+                      or r["status"] < 0),
+        "batch_fill": stats.get("batch_fill"),
+        "session_hit_rate": stats.get("session_hit_rate"),
+        "offered_rate": args.rate,
+        "mix": parse_mix(args.mix),
+        "sizes": args.size_list,
+        "models": models,
+        "wall_s": round(wall, 4),
+        "platform": __import__("jax").default_backend(),
+        "slo": slomod.results_json(slo_results),
+    }
+    print(json.dumps(rec, sort_keys=True))
+    obs.event("bench/result", **{k: v for k, v in rec.items()
+                                 if k != "classes"}, classes=classes)
+
+    tracer = obs.get_tracer()
+    tracer.flush()
+    w = getattr(tracer, "writer", None)
+    if w is not None:
+        print(f"obs: events at {w.path}; replay a request with "
+              f"python scripts/obs_report.py {w.path} --request tg-"
+              f"{args.seed}-0", file=sys.stderr, flush=True)  # noqa: obs-print
+    return 0 if completed else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

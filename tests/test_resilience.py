@@ -168,7 +168,7 @@ class _FakeEngine:
         self.metrics = metrics or ServeMetrics()
         self.max_batch = max_batch
 
-    def predict_batch(self, graphs, bucket=None):
+    def predict_batch(self, graphs, bucket=None, request_ids=None):
         if any(g.get("poison") for g in graphs):
             raise RuntimeError("injected poison graph")
         return [np.zeros((g["loc"].shape[0], 3)) for g in graphs]

@@ -1,0 +1,416 @@
+"""Tier-1 tests for request-level tracing, SLO evaluation, and the
+mixed-traffic replay harness.
+
+Covers the acceptance surface of the tracing/SLO PR: request-id minting
+and sanitization, the waterfall stitcher (queue -> batch -> compute from
+events.jsonl alone), the SLO spec/evaluator (NO DATA semantics, breach
+exit codes through the obs_report CLI), the EventWriter atexit-flush and
+per_host multi-process satellites (real subprocesses), the route-span
+lint (scripts/check_route_spans.py) wired into tier-1, and the
+traffic_gen end-to-end drill: a short mixed workload against a live
+in-process gateway socket, one BENCH line, and a complete per-request
+waterfall reconstructed by ``obs_report.py --request``.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import pytest
+
+from distegnn_tpu.obs import report, slo, trace
+from distegnn_tpu.obs.metrics import MetricsRegistry
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture
+def clean_obs():
+    yield
+    trace.configure(log_dir=None)
+
+
+def read_events(path):
+    events, bad = report.load_events(path)
+    assert bad == 0, f"unparseable lines in {path}"
+    return events
+
+
+def _ev(name, kind="event", ts=100.0, **attrs):
+    return {"ts": ts, "kind": kind, "name": name, "proc": 0, "host": "h",
+            **attrs}
+
+
+# ---- request-id minting -----------------------------------------------------
+
+def test_mint_request_id():
+    from distegnn_tpu.serve.transport import mint_request_id
+
+    assert mint_request_id("abc-123") == "abc-123"
+    assert mint_request_id("  r id\n") == "rid"        # whitespace stripped
+    assert len(mint_request_id("x" * 200)) == 64       # bounded
+    generated = mint_request_id(None)                  # minted when absent
+    assert len(generated) == 16 and generated != mint_request_id(None)
+    assert mint_request_id("\x00\x01") != ""           # garbage -> minted
+
+
+# ---- waterfall stitcher -----------------------------------------------------
+
+def _request_events(rid="r1", t0=100.0):
+    """A synthetic queue -> batch -> compute -> http record set. Span ts is
+    EXIT time (start = ts - dur_s)."""
+    return [
+        _ev("serve/prep", ts=t0 + 0.001, request_id=rid, session="s", hit=True,
+            dur_s=0.001),
+        _ev("serve/execute", kind="span", ts=t0 + 0.019, dur_s=0.008,
+            request_ids=[rid], n=64, e=256, filled=2, capacity=4),
+        _ev("serve/batch", ts=t0 + 0.020, request_ids=[rid, "other"],
+            queue_ms=[5.0, 3.0], dur_s=0.009, n=64, e=256, filled=2,
+            capacity=4, workload="predict"),
+        _ev("serve/http", kind="span", ts=t0 + 0.024, dur_s=0.022,
+            route="predict", method="POST", status=200, request_id=rid),
+    ]
+
+
+def test_stitch_request_complete_waterfall():
+    stitched = report.stitch_request(_request_events(), "r1")
+    assert stitched["complete"]
+    assert len(stitched["records"]) == 4
+    ph = stitched["phases"]
+    assert ph["queue_ms"] == pytest.approx(5.0)   # position-aligned list
+    assert ph["prep_ms"] == pytest.approx(1.0)
+    assert ph["compute_ms"] == pytest.approx(9.0)
+    assert ph["http_ms"] == pytest.approx(22.0)
+    assert stitched["stitched_ms"] == pytest.approx(15.0)
+    assert stitched["stitched_ms"] <= ph["http_ms"]
+    text = report.render_request(stitched, source="x.jsonl")
+    assert "serve/http" in text and "[queue wait]" in text
+    assert "complete" in text
+
+
+def test_stitch_request_absent_and_membership():
+    events = _request_events()
+    assert report.stitch_request(events, "nope")["records"] == []
+    # batch-level spans list member ids: "other" touches batch + execute
+    # but has no http span -> incomplete
+    other = report.stitch_request(events, "other")
+    assert [r["name"] for r in other["records"]] == ["serve/batch"]
+    assert not other["complete"]
+    assert report.request_ids_seen(events)[0] == "r1"
+
+
+# ---- SLO spec + evaluation --------------------------------------------------
+
+def test_slo_spec_validation():
+    spec = slo.SLOSpec.from_mapping({
+        "slo": {"routes": {"predict": {"p99_ms": 100.0}},
+                "error_rate_max": 0.01}})
+    assert [r.stat for r in spec.rules()] == ["predict_p99_ms", "error_rate"]
+    with pytest.raises(ValueError):
+        slo.SLOSpec.from_mapping({"routes": {"metrics": {"p99_ms": 1.0}}})
+    with pytest.raises(ValueError):
+        slo.SLOSpec.from_mapping({"routes": {"predict": {"p42_ms": 1.0}}})
+    with pytest.raises(ValueError):
+        slo.SLOSpec.from_mapping({"error_rate_max": 1.5})   # rate not in [0,1]
+    with pytest.raises(ValueError):
+        slo.SLOSpec.from_mapping({"window_s": 0.0})
+    with pytest.raises(ValueError):
+        slo.SLOSpec.from_mapping({"no_such_key": 1})
+
+
+def test_slo_evaluate_breach_and_no_data():
+    spec = slo.SLOSpec.from_mapping({
+        "routes": {"predict": {"p99_ms": 10.0}},
+        "shed_rate_max": 0.1, "batch_fill_min": 0.5})
+    results = slo.evaluate(spec, {"predict_p99_ms": 25.0, "shed_rate": 0.0})
+    by_stat = {r.rule.stat: r for r in results}
+    assert by_stat["predict_p99_ms"].ok is False          # ceiling breached
+    assert by_stat["shed_rate"].ok is True
+    assert by_stat["batch_fill"].ok is None               # NO DATA != breach
+    assert slo.breached(results)
+    table = slo.verdict_table(results, source="t")
+    assert "BREACH" in table and "NO DATA" in table and "FAIL" in table
+    j = slo.results_json(results)
+    assert j["pass"] is False and j["breached"] == ["predict_p99_ms <= 10"]
+    assert j["no_data"] == ["batch_fill >= 0.5"]
+
+
+def test_slo_stats_from_events():
+    events = [
+        _ev("serve/http", kind="span", route="predict", status=200,
+            dur_s=0.010),
+        _ev("serve/http", kind="span", route="predict", status=200,
+            dur_s=0.030),
+        _ev("serve/http", kind="span", route="predict", status=429,
+            dur_s=0.001),
+        _ev("serve/http", kind="span", route="metrics", status=200,
+            dur_s=5.0),                       # operational: excluded
+        _ev("serve/batch", filled=3, capacity=4, dur_s=0.01),
+        _ev("serve/prep", session="s", hit=True, dur_s=0.001),
+        _ev("serve/prep", session="s", hit=False, dur_s=0.002),
+    ]
+    stats = slo.stats_from_events(events)
+    assert stats["predict_p50_ms"] == pytest.approx(10.0)
+    assert stats["predict_p99_ms"] == pytest.approx(30.0)  # 429 excluded
+    assert stats["shed_rate"] == pytest.approx(1 / 3)
+    assert stats["error_rate"] == 0.0
+    assert stats["batch_fill"] == pytest.approx(0.75)
+    assert stats["session_hit_rate"] == pytest.approx(0.5)
+    assert "rollout_p99_ms" not in stats                   # NO DATA omitted
+
+
+def test_slo_monitor_window_gauges():
+    mon = slo.SLOMonitor(window_s=10.0)
+    now = 1000.0
+    mon.observe_http("predict", 10.0, 200, now=now)
+    mon.observe_http("predict", 20.0, 200, now=now + 1)
+    mon.observe_http("predict", 1.0, 429, now=now + 1)     # shed: no latency
+    mon.observe_http("metrics", 99.0, 200, now=now + 1)    # ignored route
+    reg = MetricsRegistry()
+    mon.export(reg, now=now + 2)
+    snap = reg.snapshot()
+    assert snap["slo/window_requests"] == 3
+    assert snap["slo/window_predict_p99_ms"] == pytest.approx(20.0)
+    assert snap["slo/window_shed_rate"] == pytest.approx(1 / 3)
+    # samples age out of the rolling window
+    mon.observe_http("predict", 50.0, 200, now=now + 100)
+    reg2 = MetricsRegistry()
+    mon.export(reg2, now=now + 100)
+    assert reg2.snapshot()["slo/window_requests"] == 1
+
+
+def test_obs_report_slo_cli_breach_exit(tmp_path, clean_obs):
+    t = trace.configure(log_dir=str(tmp_path))
+    t._emit("span", "serve/http", route="predict", status=200, dur_s=0.5)
+    t.flush()
+    events_path = str(tmp_path / "events.jsonl")
+    spec_ok = tmp_path / "ok.yaml"
+    spec_ok.write_text("slo:\n  routes:\n    predict:\n      p99_ms: 5000\n")
+    spec_bad = tmp_path / "bad.yaml"
+    spec_bad.write_text("slo:\n  routes:\n    predict:\n      p99_ms: 1\n")
+    env = {**os.environ, "JAX_PLATFORMS": "cpu"}
+    script = os.path.join(REPO, "scripts", "obs_report.py")
+    r = subprocess.run([sys.executable, script, events_path, "--slo",
+                        str(spec_ok)], capture_output=True, text=True,
+                       env=env, cwd=REPO)
+    assert r.returncode == 0, r.stderr
+    assert "PASS" in r.stdout
+    r = subprocess.run([sys.executable, script, events_path, "--slo",
+                        str(spec_bad), "--json"], capture_output=True,
+                       text=True, env=env, cwd=REPO)
+    assert r.returncode == 1
+    assert json.loads(r.stdout)["breached"] == ["predict_p99_ms <= 1"]
+
+
+def test_slo_config_section_validated():
+    from distegnn_tpu.config import ConfigDict, _DEFAULTS, validate_config
+
+    cfg = ConfigDict(_DEFAULTS)
+    validate_config(cfg)                       # defaults pass
+    cfg.slo.routes = {"predict": {"p99_ms": -1.0}}
+    with pytest.raises(ValueError):
+        validate_config(cfg)
+
+
+# ---- EventWriter atexit flush (subprocess satellites) -----------------------
+
+_ATEXIT_PROG = """
+import sys
+sys.path.insert(0, {repo!r})
+from distegnn_tpu.obs import trace
+# buffer larger than the event count: nothing auto-flushes; only the
+# atexit hook can make the file non-empty
+t = trace.configure(log_dir={log_dir!r}, buffer_events=10_000,
+                    flush_interval_s=3600.0)
+for i in range(20):
+    t.event("sub/tick", i=i)
+sys.exit(0)
+"""
+
+_KILL_PROG = """
+import os, sys, time
+sys.path.insert(0, {repo!r})
+from distegnn_tpu.obs import trace
+t = trace.configure(log_dir={log_dir!r}, buffer_events=10_000,
+                    flush_interval_s=3600.0)
+for i in range(20):
+    t.event("sub/tick", i=i)
+t.flush()
+print("FLUSHED", flush=True)
+time.sleep(120)            # parent SIGKILLs us here
+"""
+
+
+def test_event_writer_flushes_at_interpreter_exit(tmp_path):
+    """A run that never calls flush still leaves a complete stream behind:
+    every EventWriter registers its own atexit close."""
+    env = {**os.environ, "JAX_PLATFORMS": "cpu"}
+    r = subprocess.run(
+        [sys.executable, "-c",
+         _ATEXIT_PROG.format(repo=REPO, log_dir=str(tmp_path))],
+        capture_output=True, text=True, env=env, cwd=REPO, timeout=120)
+    assert r.returncode == 0, r.stderr
+    events = read_events(str(tmp_path / "events.jsonl"))
+    assert [e["i"] for e in events if e["name"] == "sub/tick"] == list(range(20))
+
+
+def test_event_stream_parseable_after_sigkill(tmp_path):
+    """SIGKILL after a flush: whatever was flushed is complete lines — the
+    file parses with zero bad lines (the buffered writer only ever appends
+    whole records)."""
+    env = {**os.environ, "JAX_PLATFORMS": "cpu"}
+    p = subprocess.Popen(
+        [sys.executable, "-c",
+         _KILL_PROG.format(repo=REPO, log_dir=str(tmp_path))],
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True, env=env,
+        cwd=REPO)
+    try:
+        assert p.stdout.readline().strip() == "FLUSHED"
+        p.send_signal(signal.SIGKILL)
+        p.wait(timeout=60)
+    finally:
+        if p.poll() is None:
+            p.kill()
+    assert p.returncode == -signal.SIGKILL
+    events = read_events(str(tmp_path / "events.jsonl"))   # bad == 0
+    assert len([e for e in events if e["name"] == "sub/tick"]) == 20
+
+
+# ---- per_host: one stream per process (real multi-process) ------------------
+
+_PER_HOST_PROG = """
+import sys
+sys.path.insert(0, {repo!r})
+from distegnn_tpu.obs import trace
+trace._process_index = lambda: {idx}        # what jax.process_index() returns
+t = trace.configure(log_dir={log_dir!r}, per_host=True)
+for i in range(5):
+    t.event("proc/tick", i=i)
+t.flush()
+"""
+
+
+def test_per_host_writes_one_stream_per_process(tmp_path):
+    """obs.per_host: true — >=2 REAL processes, each landing its own
+    events_p<i>.jsonl tagged with its proc index."""
+    env = {**os.environ, "JAX_PLATFORMS": "cpu"}
+    procs = [subprocess.Popen(
+        [sys.executable, "-c",
+         _PER_HOST_PROG.format(repo=REPO, idx=i, log_dir=str(tmp_path))],
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True, env=env,
+        cwd=REPO) for i in range(3)]
+    for p in procs:
+        _, err = p.communicate(timeout=120)
+        assert p.returncode == 0, err
+    for i in range(3):
+        path = tmp_path / f"events_p{i}.jsonl"
+        assert path.exists(), f"process {i} left no stream"
+        events = read_events(str(path))
+        ticks = [e for e in events if e["name"] == "proc/tick"]
+        assert len(ticks) == 5
+        assert all(e["proc"] == i for e in ticks)
+    # without per_host, a non-zero process index writes NOTHING
+    assert not (tmp_path / "events.jsonl").exists()
+
+
+# ---- route-span lint --------------------------------------------------------
+
+def _lint():
+    sys.path.insert(0, os.path.join(REPO, "scripts"))
+    try:
+        from check_route_spans import find_violations
+    finally:
+        sys.path.pop(0)
+    return find_violations
+
+
+def test_route_span_lint_clean():
+    """Tier-1 wiring of scripts/check_route_spans.py: every transport route
+    handler runs inside a serve/http span carrying a request_id."""
+    violations = _lint()()
+    assert violations == [], (
+        "transport route handler outside the serve/http span contract: "
+        f"{violations}")
+
+
+def test_route_span_lint_catches_bare_handler(tmp_path):
+    bad = tmp_path / "transport.py"
+    bad.write_text(
+        "class Handler:\n"
+        "    def do_GET(self):\n"
+        "        self.send_response(200)\n"     # bare: no dispatch forward
+        "\n"
+        "class Gateway:\n"
+        "    def dispatch(self, handler, method):\n"
+        "        self._handle(handler, method, '/', 'predict')\n"  # no span
+        "    def _handle(self, h, m, p, r):\n"
+        "        pass\n")
+    msgs = [m for _, _, m in _lint()(str(bad))]
+    assert any("bare handler do_GET" in m for m in msgs)
+    assert any("serve/http" in m for m in msgs)
+
+
+# ---- traffic_gen: the end-to-end drill --------------------------------------
+
+def test_traffic_gen_e2e_bench_line_and_waterfall(tmp_path):
+    """The PR's acceptance drill: a short mixed predict/session workload
+    through a LIVE single-process gateway socket emits exactly one BENCH
+    line (per-class p50/p99, throughput, shed, SLO verdict), and
+    ``obs_report.py --request <id>`` reconstructs a complete
+    queue -> batch -> compute waterfall from events.jsonl ALONE."""
+    env = {**os.environ, "JAX_PLATFORMS": "cpu"}
+    obs_dir = tmp_path / "tg"
+    spec = tmp_path / "slo.yaml"
+    spec.write_text("slo:\n"
+                    "  routes:\n"
+                    "    predict:\n"
+                    "      p99_ms: 60000\n"
+                    "  error_rate_max: 0.0\n")
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO, "scripts", "traffic_gen.py"),
+         "--requests", "14", "--rate", "60", "--mix",
+         "predict=0.6,session=0.4", "--sizes", "24,48", "--max-batch", "2",
+         "--sessions", "2", "--seed", "31", "--slo", str(spec),
+         "--obs-dir", str(obs_dir)],
+        capture_output=True, text=True, env=env, cwd=REPO, timeout=420)
+    assert r.returncode == 0, r.stderr
+
+    # stdout: EXACTLY one BENCH JSON line
+    lines = [ln for ln in r.stdout.strip().splitlines() if ln]
+    assert len(lines) == 1, r.stdout
+    rec = json.loads(lines[0])
+    assert rec["metric"] == "traffic_p99_ms"
+    assert rec["completed"] == 14 and rec["throughput_rps"] > 0
+    assert rec["shed"] == 0.0
+    for cls in ("predict", "session"):
+        assert rec["classes"][cls]["p50_ms"] > 0
+        assert rec["classes"][cls]["p99_ms"] >= rec["classes"][cls]["p50_ms"]
+    assert rec["slo"]["pass"] is True and rec["slo"]["rules"] == 2
+    assert "overall: PASS" in r.stderr
+
+    # every request's waterfall reconstructs from the events file alone
+    events_path = str(obs_dir / "obs" / "events.jsonl")
+    events = read_events(events_path)
+    assert any(e["name"] == "bench/result" for e in events)
+    stitched = report.stitch_request(events, "tg-31-0")
+    assert stitched["complete"], stitched
+    assert stitched["phases"]["queue_ms"] is not None
+    assert stitched["phases"]["compute_ms"] > 0
+    # ... and through the CLI, as an operator would
+    r2 = subprocess.run(
+        [sys.executable, os.path.join(REPO, "scripts", "obs_report.py"),
+         events_path, "--request", "tg-31-0"],
+        capture_output=True, text=True, env=env, cwd=REPO, timeout=120)
+    assert r2.returncode == 0, r2.stderr
+    assert "complete" in r2.stdout and "serve/http" in r2.stdout
+    # unknown ids fail with a hint
+    r3 = subprocess.run(
+        [sys.executable, os.path.join(REPO, "scripts", "obs_report.py"),
+         events_path, "--request", "nope"],
+        capture_output=True, text=True, env=env, cwd=REPO, timeout=120)
+    assert r3.returncode == 1 and "not found" in r3.stderr

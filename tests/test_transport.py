@@ -578,6 +578,100 @@ def test_registry_from_config_multi_model_routing():
         gw.close()
 
 
+# ------------------------------------------------------- request tracing
+
+def _post_traced(url, payload, rid=None, timeout=60.0):
+    """POST keeping the response headers (the X-Request-Id echo)."""
+    headers = {"Content-Type": "application/json"}
+    if rid is not None:
+        headers["X-Request-Id"] = rid
+    req = urllib.request.Request(url, data=json.dumps(payload).encode(),
+                                 headers=headers, method="POST")
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as r:
+            return r.status, json.load(r), dict(r.headers)
+    except urllib.error.HTTPError as e:
+        return e.code, json.load(e), dict(e.headers)
+
+
+def test_request_id_minted_and_echoed(live):
+    """X-Request-Id: supplied ids echo back verbatim (sanitized); absent
+    ids get minted at the edge — either way the id is in the body too."""
+    s, body, hdrs = _post_traced(live.url("/v1/models/nbody/predict"),
+                                 _payload(live.graph), rid="client-rid-7")
+    assert s == 200
+    assert hdrs["X-Request-Id"] == "client-rid-7"
+    assert body["request_id"] == "client-rid-7"
+    s, body, hdrs = _post_traced(live.url("/v1/models/nbody/predict"),
+                                 _payload(live.graph))
+    assert s == 200
+    minted = hdrs["X-Request-Id"]
+    assert len(minted) == 16 and body["request_id"] == minted
+
+
+def test_concurrent_clients_traced_end_to_end(live, tmp_path):
+    """The tracing satellite: N concurrent clients, every accepted
+    request's id lands on >=3 records (serve/http span, serve/batch event,
+    serve/execute span), and the stitched queue+prep+compute timeline is
+    bounded by the transport's reported total_ms."""
+    from distegnn_tpu.obs import report, trace
+
+    n_req = 8
+    results = [None] * n_req
+    barrier = threading.Barrier(n_req)
+    trace.configure(log_dir=str(tmp_path))
+    try:
+        def post(i):
+            barrier.wait()
+            results[i] = _post_traced(live.url("/v1/models/nbody/predict"),
+                                      _payload(live.graph), rid=f"conc-{i}")
+
+        threads = [threading.Thread(target=post, args=(i,))
+                   for i in range(n_req)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=120.0)
+        # the serve/http span exits AFTER the response bytes hit the socket,
+        # so its record can trail the clients' joins — poll until every
+        # waterfall is complete instead of flushing once
+        deadline = time.monotonic() + 20.0
+        while True:
+            trace.flush()
+            events = report.load_events(str(tmp_path / "events.jsonl"))[0]
+            if all(report.stitch_request(events, f"conc-{i}")["complete"]
+                   for i in range(n_req)) or time.monotonic() >= deadline:
+                break
+            time.sleep(0.2)
+    finally:
+        trace.configure(log_dir=None)
+
+    assert all(r is not None and r[0] == 200 for r in results)
+    for i, (status, body, hdrs) in enumerate(results):
+        rid = f"conc-{i}"
+        assert hdrs["X-Request-Id"] == rid
+        stitched = report.stitch_request(events, rid)
+        names = [r["name"] for r in stitched["records"]]
+        assert "serve/http" in names, names
+        assert "serve/batch" in names, names
+        assert "serve/execute" in names, names
+        assert len(stitched["records"]) >= 3
+        assert stitched["complete"], (rid, stitched["phases"])
+        # the stitched timeline is the inside view of total_ms: it must
+        # never exceed it, and on a sane host it accounts for most of it
+        total = float(body["total_ms"])
+        slack = max(50.0, 0.5 * total)       # CI-host tolerance
+        assert stitched["stitched_ms"] <= total + slack
+        assert total - stitched["stitched_ms"] <= slack, (
+            rid, total, stitched["phases"])
+    # batch-level records list their member ids: the concurrent burst
+    # must have coalesced at least two traced requests into one batch
+    batch_members = [e.get("request_ids") or [] for e in events
+                     if e.get("name") == "serve/batch"]
+    assert any(len([r for r in ids if r.startswith("conc-")]) > 1
+               for ids in batch_members), batch_members
+
+
 # ------------------------------------------------------------------- bench
 
 def test_serve_bench_http_transport_one_json_line(capsys):

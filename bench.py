@@ -40,6 +40,7 @@ from __future__ import annotations
 
 import json
 import os
+import re
 import signal
 import subprocess
 import sys
@@ -141,6 +142,11 @@ RACE_ORDER = (
     (["--layout", "plain"], {"BENCH_REMAT": "1"}),
     (["--layout", "plain"], None),
     (["--layout", "plain", "--fuse", "0"], {"BENCH_REORDER": "0"}),
+    # 3D-mesh leg: the shard_mapped distributed step with tensor=2 hidden-dim
+    # sharding (docs/PERFORMANCE.md "3D mesh"). Needs 2 devices — on a
+    # single-chip tunnel it fail-records in seconds and the race moves on;
+    # on CPU (test_bench_unlosable.py) bench provisions virtual devices.
+    (["--mesh", "1x1x2"], None),
 )
 
 # TPU v5e peak: 197 TFLOP/s bf16, ~98.5 TFLOP/s fp32 (public spec sheet).
@@ -151,10 +157,10 @@ PEAK_F32_FLOPS = 98.5e12
 PEAK_HBM_GBPS = 819.0
 
 
-def make_fluid_batch(rng, edge_block: int = 0, pairing: bool = False,
-                     edge_tile: int = 512, split_remote: bool = False):
-    """Synthetic fluid-like particle cloud at Fluid113K density."""
-    from distegnn_tpu.ops.graph import pad_graphs
+def make_fluid_cloud(rng):
+    """Synthetic fluid-like particle cloud at Fluid113K density, as a raw
+    graph dict (pre-padding) — shared by the single-chip measure() path and
+    the 3D-mesh leg (which partitions it before padding)."""
     from distegnn_tpu.ops.radius import radius_graph_np
 
     vol = N_NODES * (4.0 / 3.0) * np.pi * RADIUS**3 / TARGET_EDGES_PER_NODE
@@ -185,6 +191,15 @@ def make_fluid_batch(rng, edge_block: int = 0, pairing: bool = False,
         "edge_index": edge_index,
         "edge_attr": np.repeat(dist[:, None], 2, axis=1).astype(np.float32),
     }
+    return graph, n_edges
+
+
+def make_fluid_batch(rng, edge_block: int = 0, pairing: bool = False,
+                     edge_tile: int = 512, split_remote: bool = False):
+    """Padded single-chip batch of one fluid cloud (see make_fluid_cloud)."""
+    from distegnn_tpu.ops.graph import pad_graphs
+
+    graph, n_edges = make_fluid_cloud(rng)
     kw = ({"edge_block": edge_block, "edge_tile": edge_tile,
            "split_remote": split_remote}
           if edge_block else {"compute_pair": pairing})
@@ -362,6 +377,105 @@ def measure(edge_block: int, impl: str = "einsum", seg: str = "scatter",
     }
 
 
+def measure_mesh(mesh_str: str, seg: str = "scatter", fuse: bool = True):
+    """3D-mesh distributed step timing (``--mesh DxGxT``): the shard_mapped
+    train step from parallel/launch over a (data, graph, tensor) mesh. Data
+    shards hold DIFFERENT clouds; graph>1 splits each cloud with the random
+    partitioner (metis at bench node counts would dominate setup time);
+    tensor>1 slices the EGCL hidden dims per chip (parallel/collectives.py TP
+    ops — docs/PERFORMANCE.md "3D mesh" has the memory/comm model). Plain
+    edge layout + scatter aggregation only: the fused kernel's TP dispatch is
+    parity-proven in the dryrun (__graft_entry__._tensor_parity); this leg
+    answers step-time-vs-mesh-shape. vs_baseline stays None — per-chip
+    throughput across mesh shapes is the comparison, not the 1-chip anchor."""
+    import jax
+
+    from distegnn_tpu.data.partition import split_graph
+    from distegnn_tpu.models.fast_egnn import FastEGNN
+    from distegnn_tpu.ops.graph import pad_graphs
+    from distegnn_tpu.parallel.launch import (
+        batch_layout,
+        global_batch_putter,
+        make_distributed_steps,
+    )
+    from distegnn_tpu.parallel.mesh import GRAPH_AXIS, TENSOR_AXIS, make_mesh
+    from distegnn_tpu.train import TrainState, make_optimizer
+
+    if seg != "scatter":
+        sys.exit(f"--mesh supports --seg scatter only (got {seg})")
+    D, G, T = (int(v) for v in mesh_str.lower().split("x"))
+    need = D * G * T
+    if len(jax.devices()) < need:
+        sys.exit(f"--mesh {mesh_str}: needs {need} devices, "
+                 f"have {len(jax.devices())}")
+    if HIDDEN % T:
+        sys.exit(f"--mesh {mesh_str}: hidden {HIDDEN} not divisible by "
+                 f"tensor={T}")
+    mesh = make_mesh(n_graph=G, n_data=D, n_tensor=T,
+                     devices=jax.devices()[:need])
+
+    clouds, n_edges_total = [], 0
+    for s in range(D):
+        cloud, n_edges = make_fluid_cloud(np.random.default_rng(s))
+        n_edges_total += n_edges
+        clouds.append(split_graph(cloud, G, "random", inner_radius=RADIUS,
+                                  outer_radius=1.5 * RADIUS, seed=s)
+                      if G > 1 else [cloud])
+    mn = max(p["loc"].shape[0] for parts in clouds for p in parts) + 8
+    me = max(p["edge_index"].shape[1] for parts in clouds for p in parts) + 64
+
+    def stack(xs):
+        return jax.tree.map(lambda *a: np.stack(a, axis=0), *xs)
+
+    shard_stacks = [stack([pad_graphs([p], max_nodes=mn, max_edges=me)
+                           for p in parts]) for parts in clouds]
+    host_batch = stack(shard_stacks) if D > 1 else shard_stacks[0]
+
+    model = FastEGNN(
+        node_feat_nf=3, node_attr_nf=2, edge_attr_nf=2, hidden_nf=HIDDEN,
+        virtual_channels=CHANNELS, n_layers=LAYERS, compute_dtype="bf16",
+        fuse_agg=fuse, axis_name=GRAPH_AXIS,
+        tensor_axis=(TENSOR_AXIS if T > 1 else None),
+        agg_dtype=os.environ.get("BENCH_AGG_DTYPE") or None,
+        remat=bool(_env_int("BENCH_REMAT", 0)))
+    _, strip = batch_layout(D)
+    init_model = (model.copy(axis_name=None, tensor_axis=None) if T > 1
+                  else model.copy(axis_name=None))
+    params = init_model.init(jax.random.PRNGKey(0),
+                             jax.tree.map(strip, host_batch))
+    tx = make_optimizer(5e-4, weight_decay=1e-12, clip_norm=0.3)
+    state = TrainState.create(params, tx)
+    step, _ = make_distributed_steps(model, tx, mesh, mmd_weight=0.01,
+                                     mmd_sigma=3.0, mmd_samples=50)
+    gb = global_batch_putter(mesh)(host_batch)
+
+    for i in range(WARMUP):
+        state, metrics = step(state, gb, jax.random.PRNGKey(i))
+    float(metrics["loss"])  # hard sync: drain the device queue
+
+    t0 = time.perf_counter()
+    for i in range(STEPS):
+        state, metrics = step(state, gb, jax.random.PRNGKey(100 + i))
+    float(metrics["loss"])  # hard sync
+    dt = time.perf_counter() - t0
+
+    nodes_per_sec = D * N_NODES * STEPS / dt
+    platform = jax.devices()[0].platform
+    layout = f"mesh{D}x{G}x{T}"
+    if _env_int("BENCH_REMAT", 0):
+        layout += "+remat"
+    if os.environ.get("BENCH_AGG_DTYPE"):
+        layout += f"+agg{os.environ['BENCH_AGG_DTYPE']}"
+    return {
+        "metric": "largefluid_train_nodes_per_sec_per_chip",
+        "value": round(nodes_per_sec / need, 1),
+        "unit": (f"nodes/sec/chip (N={N_NODES} x D={D}, E={n_edges_total}, "
+                 f"step={dt / STEPS * 1e3:.1f}ms, platform={platform}, "
+                 f"layout={layout}, devices={need}, sync=fetch)"),
+        "vs_baseline": None,
+    }
+
+
 def main():
     # BENCH_PLATFORM=cpu pins the backend for smoke tests — NOTE env var
     # JAX_PLATFORMS alone is not enough on axon-tunnel hosts (the tunnel
@@ -383,10 +497,17 @@ def main():
         jax.config.update("jax_platforms", plat)
 
     args = sys.argv[1:]
-    layout, impl, seg, fuse = "auto", "einsum", "scatter", True
+    layout, impl, seg, fuse, mesh_str = "auto", "einsum", "scatter", True, None
     usage = ("usage: bench.py [--layout plain|blocked|fused|auto] "
              "[--impl pallas|einsum] [--seg scatter|cumsum|ell] "
-             "[--fuse 0|1]  (env: BENCH_REORDER, BENCH_AGG_DTYPE)")
+             "[--fuse 0|1] [--mesh DxGxT]  "
+             "(env: BENCH_REORDER, BENCH_AGG_DTYPE)")
+    if "--mesh" in args:
+        i = args.index("--mesh")
+        if i + 1 >= len(args) or not re.fullmatch(r"\d+x\d+x\d+",
+                                                  args[i + 1].lower()):
+            sys.exit(usage)
+        mesh_str = args[i + 1].lower()
     if "--layout" in args:
         i = args.index("--layout")
         if i + 1 >= len(args) or args[i + 1] not in ("plain", "blocked", "fused",
@@ -408,6 +529,27 @@ def main():
         if i + 1 >= len(args) or args[i + 1] not in ("0", "1"):
             sys.exit(usage)
         fuse = args[i + 1] == "1"
+
+    if mesh_str is not None:
+        # CPU runs (smoke tests) need the virtual devices provisioned BEFORE
+        # the backend initializes; harmless no-op when it already is (the
+        # RuntimeError path) or on real hardware.
+        if plat == "cpu" or os.environ.get("JAX_PLATFORMS") == "cpu":
+            import jax
+
+            need = int(np.prod([int(v) for v in mesh_str.split("x")]))
+            try:
+                jax.config.update("jax_num_cpu_devices", max(need, 1))
+            except (RuntimeError, AttributeError):
+                # older jax: the XLA flag is read at backend init, which has
+                # not happened yet on this path
+                if "--xla_force_host_platform_device_count" not in \
+                        os.environ.get("XLA_FLAGS", ""):
+                    os.environ["XLA_FLAGS"] = (
+                        os.environ.get("XLA_FLAGS", "")
+                        + f" --xla_force_host_platform_device_count={need}")
+        _emit_bench(measure_mesh(mesh_str, seg, fuse))
+        return
 
     edge_block = _env_int("BENCH_EDGE_BLOCK", 256)
     if layout == "probe":

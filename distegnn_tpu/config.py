@@ -218,6 +218,19 @@ _DEFAULTS: dict = {
             "warmup_nodes": [48, 96],
         },
     },
+    # mesh layout (distegnn_tpu/parallel/mesh.py): the 3D device mesh
+    # (data, graph, tensor). data/graph null = derive from data.data_parallel
+    # and the device count (the legacy 2D behavior); tensor = hidden-dim
+    # tensor parallelism degree T (NeutronTP-style feature split; FastEGNN
+    # only, model.hidden_nf % T == 0, data*graph*tensor == devices used).
+    # Omitting the section (or tensor: 1) is bitwise-identical to the 2D mesh.
+    "parallel": {
+        "mesh": {
+            "data": None,
+            "graph": None,
+            "tensor": 1,
+        },
+    },
     # observability (distegnn_tpu/obs, docs/OBSERVABILITY.md) — structured
     # tracing + run metrics + JAX compile/memory probes. Default-on: spans
     # and events cost ~1us each and the writer is buffered; `enable: false`
@@ -313,6 +326,9 @@ _CLI_FIELDS = {
     "world_size": ("data.world_size", int),
     # TPU-only extension: mesh data axis size (not a reference flag)
     "data_parallel": ("data.data_parallel", int),
+    # TPU-only extension: hidden-dim tensor parallelism degree T
+    # (parallel.mesh.tensor; mesh grows a third axis when > 1)
+    "tensor_parallel": ("parallel.mesh.tensor", int),
     # resilience: 'auto' or an explicit checkpoint path (train.resume)
     "resume": ("train.resume", str),
 }
@@ -402,6 +418,48 @@ def validate_config(cfg: ConfigDict) -> None:
         if bool(cfg.model.normalize):
             raise ValueError("model.edge_impl='fused' does not support "
                              "model.normalize (flagship EGCL only)")
+    par = cfg.get("parallel")
+    mesh = par.get("mesh") if par is not None else None
+    if mesh is not None:
+        if not isinstance(mesh, Mapping):
+            raise ValueError("parallel.mesh must be a mapping with optional "
+                             "keys data/graph/tensor")
+        for key in mesh:
+            if key not in ("data", "graph", "tensor"):
+                raise ValueError(f"parallel.mesh: unknown key {key!r} "
+                                 "(valid: data, graph, tensor)")
+        for key in ("data", "graph", "tensor"):
+            val = mesh.get(key, None if key != "tensor" else 1)
+            if val is not None and int(val) < 1:
+                raise ValueError(f"parallel.mesh.{key} must be >= 1")
+        tensor = int(mesh.get("tensor", 1) or 1)
+        if tensor > 1:
+            hidden = int(cfg.model.hidden_nf)
+            if hidden % tensor:
+                raise ValueError(
+                    f"parallel.mesh.tensor={tensor} must divide "
+                    f"model.hidden_nf={hidden} (each chip owns a contiguous "
+                    f"1/T hidden slice)")
+            if cfg.model.model_name != "FastEGNN":
+                raise ValueError(
+                    f"parallel.mesh.tensor > 1 is only implemented for "
+                    f"FastEGNN, not model.model_name="
+                    f"{cfg.model.model_name!r}")
+            if not bool(cfg.model.get("hoist_edge_mlp", True)):
+                raise ValueError(
+                    "parallel.mesh.tensor > 1 requires "
+                    "model.hoist_edge_mlp=true (phi_e's tensor collective is "
+                    "the node-level gather of the hoisted products)")
+            if bool(cfg.model.get("tanh", False)):
+                raise ValueError(
+                    "parallel.mesh.tensor > 1 does not support model.tanh "
+                    "(phi_x's psum is deferred through linear ops only)")
+        mdata = mesh.get("data")
+        dp = int(cfg.data.data_parallel)
+        if mdata is not None and dp != 1 and int(mdata) != dp:
+            raise ValueError(
+                f"parallel.mesh.data={int(mdata)} conflicts with "
+                f"data.data_parallel={dp} — set one of them")
     o = cfg.get("obs")
     if o is not None:
         for flag in ("enable", "per_host", "jax_probe", "step_events"):

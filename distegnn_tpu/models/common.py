@@ -14,6 +14,10 @@ from typing import Any, Callable, Optional, Sequence
 import jax.numpy as jnp
 from flax import linen as nn
 
+from distegnn_tpu.parallel.collectives import (
+    tp_copy, tp_gather, tp_reduce, tp_slice, tp_slice_rows,
+)
+
 # torch nn.Linear default weight init (same variance): U(+-1/sqrt(fan_in))
 torch_linear_init = nn.initializers.variance_scaling(1.0 / 3.0, "fan_in", "uniform")
 # xavier_uniform(gain=0.001): bound = gain*sqrt(6/(fan_in+fan_out)) -> scale = gain^2
@@ -53,8 +57,55 @@ class TorchDense(nn.Module):
         )(x)
 
 
+class _DenseParams(nn.Module):
+    """Shadow of nn.Dense's param subtree: declares the identical
+    kernel/bias (same names, shapes, f32 param dtype, init functions) WITHOUT
+    applying the matmul, and returns the full arrays. Instantiated with
+    ``name='Dense_0'`` inside a ``name='TorchDense_i'`` shadow so the param
+    path — and therefore flax's path-folded init RNG stream — is bitwise
+    identical to the TorchDense it stands in for. This is how the
+    tensor-parallel compute branches consume FULL replicated params (sliced at
+    compute time via collectives.tp_slice*) while keeping the param tree
+    invariant in the mesh shape, so checkpoints cross mesh layouts freely."""
+
+    features: int
+    use_bias: bool = True
+    kernel_init: Optional[Callable] = None
+
+    @nn.compact
+    def __call__(self, fan_in):
+        k = self.param("kernel", self.kernel_init or torch_linear_init,
+                       (fan_in, self.features), jnp.float32)
+        b = (self.param("bias", _torch_bias_init(fan_in), (self.features,), jnp.float32)
+             if self.use_bias else None)
+        return k, b
+
+
+class _TorchDenseParams(nn.Module):
+    """Shadow of TorchDense's param subtree (see :class:`_DenseParams`)."""
+
+    features: int
+    use_bias: bool = True
+    kernel_init: Optional[Callable] = None
+
+    @nn.compact
+    def __call__(self, fan_in):
+        return _DenseParams(self.features, use_bias=self.use_bias,
+                            kernel_init=self.kernel_init, name="Dense_0")(fan_in)
+
+
 class MLP(nn.Module):
-    """Plain MLP: Dense(+act) stack; optionally activation after the last layer."""
+    """Plain MLP: Dense(+act) stack; optionally activation after the last layer.
+
+    ``tensor_axis`` enables Megatron-style tensor parallelism over the hidden
+    dim (2-layer MLPs only): the first Dense is column-parallel (each tensor
+    rank computes a contiguous 1/T hidden slice — exact, just fewer columns),
+    the activation runs on the slice, and the second Dense is row-parallel.
+    ``tensor_out='reduce'`` closes with ONE psum back to the full output
+    (the per-MLP layer-boundary collective); ``tensor_out='partial'`` returns
+    the rank-local partial sum so a linear consumer (phi_x's coordinate
+    aggregation) can defer the psum to the node axis. Params stay full and
+    replicated — the tree is identical to tensor_axis=None."""
 
     sizes: Sequence[int]
     act: Callable = nn.silu
@@ -62,10 +113,14 @@ class MLP(nn.Module):
     use_bias_last: bool = True
     kernel_init_last: Optional[Callable] = None
     dtype: Optional[Any] = None
+    tensor_axis: Optional[str] = None
+    tensor_out: str = "reduce"
 
     @nn.compact
     def __call__(self, x):
         n = len(self.sizes)
+        if self.tensor_axis is not None:
+            return self._tp_call(x)
         for i, size in enumerate(self.sizes):
             last = i == n - 1
             x = TorchDense(
@@ -78,6 +133,38 @@ class MLP(nn.Module):
                 x = self.act(x)
         return x
 
+    def _tp_call(self, x):
+        ax = self.tensor_axis
+        if len(self.sizes) != 2:
+            raise ValueError(
+                f"tensor-parallel MLP supports exactly 2 dense layers, got "
+                f"sizes={list(self.sizes)}")
+        if self.tensor_out not in ("reduce", "partial"):
+            raise ValueError(f"unknown tensor_out {self.tensor_out!r}")
+        if self.tensor_out == "partial" and self.use_bias_last:
+            raise ValueError(
+                "tensor_out='partial' requires use_bias_last=False (a bias "
+                "on a partial sum would be counted T times)")
+        fan0 = x.shape[-1]
+        k0, b0 = _TorchDenseParams(self.sizes[0], name="TorchDense_0")(fan0)
+        k1, b1 = _TorchDenseParams(
+            self.sizes[1], use_bias=self.use_bias_last,
+            kernel_init=self.kernel_init_last, name="TorchDense_1")(self.sizes[0])
+        c = (lambda a: a.astype(self.dtype)) if self.dtype is not None else (lambda a: a)
+        # column-parallel first Dense: exact 1/T column slice of the full
+        # kernel; activation is elementwise so the slice stays exact
+        h = self.act(tp_copy(c(x), ax) @ tp_slice(c(k0), ax) + tp_slice(c(b0), ax))
+        # row-parallel second Dense: rank-local partial contraction
+        y = h @ tp_slice_rows(c(k1), ax)
+        if self.tensor_out == "partial":
+            return y
+        y = tp_reduce(y, ax)                 # the one psum at the MLP boundary
+        if b1 is not None:
+            y = y + c(b1)
+        if self.act_last:
+            y = self.act(y)
+        return y
+
 
 class CoordMLP(nn.Module):
     """Dense(H) -> act -> Dense(1, no bias, xavier gain 1e-3) [-> tanh].
@@ -89,15 +176,27 @@ class CoordMLP(nn.Module):
     act: Callable = nn.silu
     tanh: bool = False
     dtype: Optional[Any] = None
+    # tensor-parallel hidden dim: the head returns a rank-local PARTIAL
+    # scalar (row-parallel second Dense, psum deferred); the caller multiplies
+    # it into coord_diff, segment-sums to the node axis, and closes with one
+    # tp_reduce there — all linear ops, so deferring the psum is exact.
+    # Incompatible with tanh (nonlinear in the partial sum).
+    tensor_axis: Optional[str] = None
 
     @nn.compact
     def __call__(self, x):
+        if self.tensor_axis is not None and self.tanh:
+            raise ValueError(
+                "CoordMLP: tanh=True cannot be tensor-parallel (the psum is "
+                "deferred through linear ops only) — use tanh=False or T=1")
         x = MLP(
             [self.hidden_nf, 1],
             act=self.act,
             use_bias_last=False,
             kernel_init_last=coord_head_init,
             dtype=self.dtype,
+            tensor_axis=self.tensor_axis,
+            tensor_out="partial",
         )(x)
         # the scalar head feeds geometry (coord_diff multiplies it): return f32
         x = x.astype(jnp.float32)
@@ -146,6 +245,12 @@ class HoistedEdgeMLP(nn.Module):
     scalar_nf: int           # per-edge scalar features: radial (+ edge_attr)
     act: Callable = nn.silu
     dtype: Optional[Any] = None
+    # tensor-parallel hidden dim: only the two hoisted NODE-axis matmuls
+    # (h @ wr, h @ wc — the dominant cost) are column-sliced; ONE node-level
+    # all-gather per product restores the full hidden dim before the cheap
+    # per-edge work, so everything per-edge (and the second Dense) stays
+    # replicated. Column slicing + tiled gather is bitwise-exact.
+    tensor_axis: Optional[str] = None
 
     @nn.compact
     def __call__(self, h, scalars, ops):
@@ -153,8 +258,19 @@ class HoistedEdgeMLP(nn.Module):
         fan_in = 2 * H + self.scalar_nf
         w = self.param("kernel", torch_linear_init, (fan_in, H), jnp.float32)
         b = self.param("bias", _torch_bias_init(fan_in), (H,), jnp.float32)
-        y = self.act(_hoisted_linear(w, b, h, scalars, ops, H,
-                                     scalars_first=False, dtype=self.dtype))
+        if self.tensor_axis is not None:
+            ax = self.tensor_axis
+            dt = self.dtype
+            hc_, sc_, wc_, bc_ = ((a.astype(dt) for a in (h, scalars, w, b))
+                                  if dt is not None else (h, scalars, w, b))
+            hin = tp_copy(hc_, ax)
+            hr = tp_gather(hin @ tp_slice(wc_[:H], ax), ax)
+            hcv = tp_gather(hin @ tp_slice(wc_[H:2 * H], ax), ax)
+            y = self.act(ops.gather_rows(hr) + ops.gather_cols(hcv)
+                         + sc_ @ wc_[2 * H:] + bc_)
+        else:
+            y = self.act(_hoisted_linear(w, b, h, scalars, ops, H,
+                                         scalars_first=False, dtype=self.dtype))
         return self.act(TorchDense(H, dtype=self.dtype)(y))
 
 

@@ -31,7 +31,9 @@ from distegnn_tpu.ops.blocked import EdgeOps, blocked_slot_inv_deg
 from distegnn_tpu.ops.edge_pipeline import (EdgeWeights, build_edge_blocks,
                                             fused_edge_layer)
 from distegnn_tpu.ops.graph import GraphBatch
-from distegnn_tpu.parallel.collectives import global_node_mean
+from distegnn_tpu.parallel.collectives import (
+    global_node_mean, tp_copy, tp_gather, tp_once, tp_reduce, tp_slice,
+)
 
 
 class FusedEdgeParams(nn.Module):
@@ -80,6 +82,17 @@ class EGCLVel(nn.Module):
     tanh: bool = False
     has_gravity: bool = False
     axis_name: Optional[str] = None  # mesh axis of graph partitions ('graph') or None
+    # mesh axis of the hidden-dim shards ('tensor') or None. When set, each
+    # chip computes a 1/T hidden slice of phi_e/phi_x/phi_h per edge/node
+    # block, with exactly one collective per MLP at the layer boundary:
+    # phi_e — node-level tiled all-gather of the hoisted h@W products;
+    # phi_x — partial per-edge scalars ride coord_diff and the segment sum
+    #         to the node axis, then ONE psum of the [B,N,3] aggregate;
+    # phi_h — Megatron column/row split closed by ONE psum of [B,N,H].
+    # Virtual-node MLPs (C channels, tiny) stay replicated. Params stay FULL
+    # on every chip — slicing happens at compute time — so the param tree,
+    # checkpoints, and the (data, graph) gradient psum are unchanged.
+    tensor_axis: Optional[str] = None
     epsilon: float = 1e-8
     # compute dtype of the invariant-message MLPs ('bf16' or None=f32). All
     # GEOMETRY (coord_diff, radial, coordinate updates, aggregations) stays
@@ -162,13 +175,38 @@ class EGCLVel(nn.Module):
             w1, b1, w2, b2, w3, b3, w4 = FusedEdgeParams(
                 H, 1 + self.edge_attr_nf, name="phi_e_fused")()
             c = (lambda a: a.astype(dt)) if dt is not None else (lambda a: a)
-            hr = c(h) @ c(w1[:H])          # hoisted node-axis products
-            hc = c(h) @ c(w1[H:2 * H])     # (HoistedEdgeMLP algebra)
-            kw = EdgeWeights(ws=w1[2 * H:], b1=b1[None], w2=w2, b2=b2[None],
-                             w3=w3, b3=b3[None], w4=w4.T)
+            tx = self.tensor_axis
+            if tx is not None:
+                # Tensor-parallel dispatch of the SAME kernel: the hoisted
+                # node-axis products are column-sliced then gathered (phi_e's
+                # collective), and the phi_x head weights (w3/b3/w4) flow in
+                # as 1/T slices — the kernel derives every internal shape from
+                # its operands, so no kernel change. Its trans_sum output
+                # becomes a rank-local partial (closed by one node-level psum
+                # below); ef_sum/count stay replicated. Kernel inputs carrying
+                # gradients are wrapped in tp_copy (bwd psum) because the
+                # kernel's cotangents mix the partial phi_x path with the
+                # replicated phi_e path; the replicated outputs are wrapped in
+                # tp_once (bwd /T) so that psum counts their cotangent once.
+                hcp = tp_copy(c(h), tx)
+                hr = tp_gather(hcp @ tp_slice(c(w1[:H]), tx), tx)
+                hc = tp_gather(hcp @ tp_slice(c(w1[H:2 * H]), tx), tx)
+                hr, hc = tp_copy(hr, tx), tp_copy(hc, tx)
+                kw = EdgeWeights(ws=tp_copy(w1[2 * H:], tx),
+                                 b1=tp_copy(b1, tx)[None],
+                                 w2=tp_copy(w2, tx), b2=tp_copy(b2, tx)[None],
+                                 w3=tp_slice(w3, tx), b3=tp_slice(b3, tx)[None],
+                                 w4=tp_slice(w4.T, tx))
+                xk = tp_copy(x, tx)
+            else:
+                hr = c(h) @ c(w1[:H])          # hoisted node-axis products
+                hc = c(h) @ c(w1[H:2 * H])     # (HoistedEdgeMLP algebra)
+                kw = EdgeWeights(ws=w1[2 * H:], b1=b1[None], w2=w2, b2=b2[None],
+                                 w3=w3, b3=b3[None], w4=w4.T)
+                xk = x
             dname = "bf16" if dt is jnp.bfloat16 else "f32"
             row_t, col_l, kblk, scal = fused_arrs
-            outs = [fused_edge_layer(x[b], hr[b], hc[b], row_t[b], col_l[b],
+            outs = [fused_edge_layer(xk[b], hr[b], hc[b], row_t[b], col_l[b],
                                      kblk[b], scal[b], kw, g.edge_block, dname)
                     for b in range(h.shape[0])]
             trans_sum = jnp.stack([o[0] for o in outs])          # [B, N, 3]
@@ -176,24 +214,41 @@ class EGCLVel(nn.Module):
             ef_sum = jnp.stack([o[2] for o in outs])             # [B, N, H]
 
             # remote tail (~5-8% of E): identical math, dense over the
-            # compact out-of-window edge list carried on the batch
+            # compact out-of-window edge list carried on the batch. Under
+            # tensor parallelism it dispatches with the SAME weight slicing
+            # as the kernel so the combined trans_sum stays one partial.
+            if tx is not None:
+                cws, cb1 = tp_copy(c(w1[2 * H:]), tx), tp_copy(c(b1), tx)
+                cw2, cb2 = tp_copy(c(w2), tx), tp_copy(c(b2), tx)
+                cw3, cb3 = tp_slice(c(w3), tx), tp_slice(c(b3), tx)
+                w4r = tp_slice(w4.T, tx).T                       # [H/T, 1]
+            else:
+                cws, cb1, cw2, cb2, cw3, cb3, w4r = (
+                    c(w1[2 * H:]), c(b1), c(w2), c(b2), c(w3), c(b3), w4)
             rr, rc = g.remote_edge_index[:, 0], g.remote_edge_index[:, 1]
             rm = g.remote_edge_mask[..., None]                   # [B, R, 1]
-            cd_r = (gather_nodes(x, rr) - gather_nodes(x, rc)) * rm
+            cd_r = (gather_nodes(xk, rr) - gather_nodes(xk, rc)) * rm
             radial_r = jnp.sum(cd_r * cd_r, axis=-1, keepdims=True)
             sfeat = c(jnp.concatenate(
                 [radial_r, g.remote_edge_attr[..., :2]], axis=-1))
             t1 = (gather_nodes(hr, rr) + gather_nodes(hc, rc)
-                  + sfeat @ c(w1[2 * H:]) + c(b1))
-            ef_r = nn.silu(nn.silu(t1) @ c(w2) + c(b2))          # [B, R, H]
-            y2 = nn.silu(ef_r @ c(w3) + c(b3))
-            g_r = (y2.astype(jnp.float32) @ w4) * rm             # [B, R, 1]
+                  + sfeat @ cws + cb1)
+            ef_r = nn.silu(nn.silu(t1) @ cw2 + cb2)              # [B, R, H]
+            y2 = nn.silu(ef_r @ cw3 + cb3)
+            g_r = (y2.astype(jnp.float32) @ w4r) * rm            # [B, R, 1]
             N_ = x.shape[1]
             seg = jax.vmap(
                 lambda val, r: jax.ops.segment_sum(val, r, num_segments=N_))
             trans_sum = trans_sum + seg(cd_r * g_r, rr)
             count = count + seg(g.remote_edge_mask, rr)
             ef_sum = ef_sum + seg(ef_r.astype(jnp.float32) * rm, rr)
+            if tx is not None:
+                # close phi_x with its ONE node-level psum; ef_sum/count were
+                # computed redundantly on every tensor rank — tp_once makes
+                # the tp_copy-psum'd input cotangents count them exactly once
+                trans_sum = tp_reduce(trans_sum, tx)
+                ef_sum = tp_once(ef_sum, tx)
+                count = tp_once(count, tx)
 
             cnt = jnp.maximum(count, 1.0)[..., None]
             agg = trans_sum / cnt if self.coords_agg == "mean" else trans_sum
@@ -211,8 +266,16 @@ class EGCLVel(nn.Module):
                 scalars = (jnp.concatenate([radial, g.edge_attr], axis=-1)
                            if self.edge_attr_nf else radial)
                 edge_feat = HoistedEdgeMLP(H, 1 + self.edge_attr_nf,
-                                           name="phi_e", dtype=dt)(h, scalars, ops)
+                                           name="phi_e", dtype=dt,
+                                           tensor_axis=self.tensor_axis)(
+                                               h, scalars, ops)
             else:
+                if self.tensor_axis is not None:
+                    raise ValueError(
+                        "tensor parallelism requires hoist_edge_mlp=True "
+                        "(phi_e's collective is the node-level gather of the "
+                        "hoisted products; the concat-shaped phi_e would "
+                        "need a per-edge gather)")
                 e_in = [ops.gather_rows(h), ops.gather_cols(h), radial]
                 if self.edge_attr_nf:
                     e_in.append(g.edge_attr)
@@ -254,7 +317,15 @@ class EGCLVel(nn.Module):
         # --- real coordinate update (coord_model_vel, :166-188); the fused
         # path already holds the aggregated translations in `agg`
         if not fused:
-            trans = coord_diff * CoordMLP(H, tanh=self.tanh, name="phi_x", dtype=dt)(edge_feat)  # [B, E, 3]
+            # tensor-parallel phi_x returns a rank-local PARTIAL scalar; it
+            # rides coord_diff and the row aggregation (all linear) to the
+            # node axis, where ONE psum of [B, N, 3] closes the MLP —
+            # per-edge traffic never crosses the tensor axis. coord_diff is
+            # tp_copy-wrapped so its cotangent (partial per rank) is summed.
+            cdm = (tp_copy(coord_diff, self.tensor_axis)
+                   if self.tensor_axis is not None else coord_diff)
+            trans = cdm * CoordMLP(H, tanh=self.tanh, name="phi_x", dtype=dt,
+                                   tensor_axis=self.tensor_axis)(edge_feat)  # [B, E, 3]
             if self.fuse_agg:
                 # both per-layer aggregations (+ the count) in ONE pass (blocked
                 # layouts keep two calls inside but honor the agg_dtype knob)
@@ -265,6 +336,8 @@ class EGCLVel(nn.Module):
                 agg = (ops.agg_rows_sum(trans) if self.coords_agg == "sum"
                        else ops.agg_rows_mean(trans))                    # [B, N, 3]
                 agg_h_f = None
+            if self.tensor_axis is not None:
+                agg = tp_reduce(agg, self.tensor_axis)
         x = x + agg
 
         phi_xv = CoordMLP(H, tanh=self.tanh, name="phi_xv", dtype=dt)(vef)  # [B, N, C, 1]
@@ -285,8 +358,9 @@ class EGCLVel(nn.Module):
         n_in = [h, agg_h, agg_v]
         if self.node_attr_nf:
             n_in.append(g.node_attr)
-        out = MLP([H, H], name="phi_h", dtype=dt)(jnp.concatenate(
-            [a.astype(jnp.float32) for a in n_in], axis=-1))
+        out = MLP([H, H], name="phi_h", dtype=dt,
+                  tensor_axis=self.tensor_axis)(jnp.concatenate(
+                      [a.astype(jnp.float32) for a in n_in], axis=-1))
         h = (h + out) if self.residual else out
         h = h * nm
 
@@ -320,6 +394,9 @@ class FastEGNN(nn.Module):
     tanh: bool = False
     gravity: Optional[Tuple[float, float, float]] = None
     axis_name: Optional[str] = None
+    # mesh axis for hidden-dim tensor parallelism ('tensor') or None; see
+    # EGCLVel.tensor_axis. hidden_nf must be divisible by the axis size.
+    tensor_axis: Optional[str] = None
     compute_dtype: Optional[str] = None  # 'bf16' -> MXU-native message MLPs
     hoist_edge_mlp: bool = True  # phi_e first Dense on the node axis (see EGCLVel)
     # lowering of the blocked-layout edge ops (used only when the batch
@@ -391,6 +468,7 @@ class FastEGNN(nn.Module):
                 tanh=self.tanh,
                 has_gravity=self.gravity is not None,
                 axis_name=self.axis_name,
+                tensor_axis=self.tensor_axis,
                 compute_dtype=self.compute_dtype,
                 hoist_edge_mlp=self.hoist_edge_mlp,
                 seg_impl=self.segment_impl,

@@ -26,14 +26,22 @@ def _import_model(module: str, cls: str):
 
 
 def get_model(model_config, world_size: int = 1, dataset_name: Optional[str] = None,
-              axis_name: Optional[str] = None):
+              axis_name: Optional[str] = None, tensor_axis: Optional[str] = None):
     """model_config: attribute-style config (see distegnn_tpu.config).
 
     ``axis_name`` is the mesh axis for distributed (DistEGNN-style) runs; pass
     'graph' when calling under shard_map, None single-device — replaces the
     reference's world_size branches inside the model.
+
+    ``tensor_axis`` is the mesh axis for hidden-dim tensor parallelism
+    ('tensor' when parallel.mesh.tensor > 1, else None). Only FastEGNN
+    supports it; config validation rejects tensor>1 for other families.
     """
     name = model_config.model_name
+    if tensor_axis is not None and name != "FastEGNN":
+        raise ValueError(
+            f"tensor parallelism (parallel.mesh.tensor > 1) is only "
+            f"implemented for FastEGNN, not {name!r}")
     if name == "FastEGNN":
         from distegnn_tpu.models.fast_egnn import FastEGNN
         return FastEGNN(
@@ -46,6 +54,7 @@ def get_model(model_config, world_size: int = 1, dataset_name: Optional[str] = N
             normalize=model_config.normalize,
             gravity=None,
             axis_name=axis_name,
+            tensor_axis=tensor_axis,
             compute_dtype=model_config.get("compute_dtype"),
             remat=bool(model_config.get("remat", False)),
             blocked_impl=model_config.get("blocked_impl", "einsum"),

@@ -167,6 +167,22 @@ def emit_memory_event(tracer: Optional[_trace.Tracer] = None,
     return stats
 
 
+def record_memory_gauges(tag: str,
+                         registry: Optional[_metrics.MetricsRegistry] = None,
+                         ) -> Dict[str, Any]:
+    """Per-chip HBM footprint as registry gauges (``mem/<tag>/<key>``) —
+    the obs_report/snapshot view that pairs with :func:`emit_memory_event`'s
+    events.jsonl view. The 3D-mesh sizing question this answers: does the
+    T-way hidden-dim shard actually shrink ``peak_bytes_in_use`` per chip?
+    Empty dict (no gauges) on CPU, where the backend reports no stats."""
+    reg = registry or _metrics.get_registry()
+    stats = device_memory_stats()
+    for k in ("bytes_in_use", "peak_bytes_in_use", "largest_alloc_size"):
+        if k in stats:
+            reg.gauge(f"mem/{tag}/{k}").set(float(stats[k]))
+    return stats
+
+
 # ---- host<->device transfer accounting -------------------------------------
 
 def tree_nbytes(tree) -> int:

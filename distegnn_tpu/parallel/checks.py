@@ -10,7 +10,10 @@ These checks make the invariant EXECUTABLE rather than assumed:
 
 - :func:`assert_replicated` — every addressable shard of every param is
   bitwise identical, and (multi-host) every process holds the same
-  fingerprint.
+  fingerprint. This spans ALL mesh axes, including the tensor axis: tensor
+  parallelism slices activations at compute time but keeps the param TREE
+  full and replicated (models/common.py), so a tensor rank holding diverged
+  weights is exactly as much a bug as a diverged data rank.
 - :func:`batch_fingerprint` — the per-step data-order invariant: hosts must
   feed identical logical batches; compare fingerprints across processes.
 

@@ -52,6 +52,152 @@ def global_node_sum(data: jnp.ndarray, mask: jnp.ndarray, axis_name: Optional[st
     return s, c
 
 
+# ---------------------------------------------------------------------------
+# Tensor-parallel collectives (hidden-dim sharding over TENSOR_AXIS).
+#
+# The EGCL MLPs are Megatron-split: the first dense is column-parallel (each
+# chip computes a contiguous 1/T slice of the hidden dim), the second is
+# row-parallel (each chip contracts its slice, then one psum restores the full
+# output). Params stay FULL and replicated on every chip — slicing happens at
+# compute time inside the model (see models/common.py) — so checkpoints,
+# optimizer state, and the DDP gradient psum over (data, graph) are untouched.
+#
+# That replication makes the naive autodiff of psum/all_gather wrong: the loss
+# is computed once per tensor rank, so transposed collectives double-count
+# gradients by T. These custom VJPs implement the "loss counted once" rules
+# (each is the transpose of its partner):
+#
+#   tp_copy    fwd identity          bwd psum      (entering a sharded region)
+#   tp_reduce  fwd psum              bwd identity  (row-parallel contraction)
+#   tp_gather  fwd all_gather(tiled) bwd slice     (column-parallel collection)
+#   tp_slice   fwd slice             bwd all_gather(tiled)
+#
+# With these, every param gradient comes out tensor-replicated, so the train
+# step's gradient psum over (data, graph) needs no change for T>1.
+# ---------------------------------------------------------------------------
+
+
+def _tp_slice_bounds(full_dim: int, axis_name: str):
+    """(per-rank width, this rank's start offset) for a contiguous 1/T slice."""
+    t = jax.lax.psum(1, axis_name)
+    if full_dim % t != 0:
+        raise ValueError(f"hidden dim {full_dim} not divisible by tensor size {t}")
+    width = full_dim // t
+    return width, jax.lax.axis_index(axis_name) * width
+
+
+def tp_copy(x, axis_name: Optional[str] = None):
+    """Identity forward; psums the cotangent over the tensor axis.
+
+    Use where a tensor-replicated activation enters a sharded computation: the
+    forward value is the same on every rank, but each rank contributes an
+    independent gradient that must be summed.
+    """
+    if axis_name is None:
+        return x
+
+    @jax.custom_vjp
+    def _copy(v):
+        return v
+
+    _copy.defvjp(lambda v: (v, None), lambda _, g: (jax.lax.psum(g, axis_name),))
+    return _copy(x)
+
+
+def tp_reduce(x, axis_name: Optional[str] = None):
+    """psum forward (row-parallel contraction back to model dim); identity bwd."""
+    if axis_name is None:
+        return x
+
+    @jax.custom_vjp
+    def _reduce(v):
+        return jax.lax.psum(v, axis_name)
+
+    _reduce.defvjp(lambda v: (jax.lax.psum(v, axis_name), None), lambda _, g: (g,))
+    return _reduce(x)
+
+
+def tp_gather(x, axis_name: Optional[str] = None):
+    """all_gather slices along the last dim forward; slice the cotangent bwd."""
+    if axis_name is None:
+        return x
+
+    @jax.custom_vjp
+    def _gather(v):
+        return jax.lax.all_gather(v, axis_name, axis=v.ndim - 1, tiled=True)
+
+    def _fwd(v):
+        return _gather(v), v.shape[-1]
+
+    def _bwd(width, g):
+        start = jax.lax.axis_index(axis_name) * width
+        return (jax.lax.dynamic_slice_in_dim(g, start, width, axis=g.ndim - 1),)
+
+    _gather.defvjp(_fwd, _bwd)
+    return _gather(x)
+
+
+def tp_slice(x, axis_name: Optional[str] = None):
+    """This rank's contiguous 1/T slice of the last dim fwd; all_gather bwd.
+
+    Used to carve a rank-local column block out of a FULL replicated param at
+    compute time (the param tree itself stays mesh-shape independent).
+    """
+    if axis_name is None:
+        return x
+    width, start = _tp_slice_bounds(x.shape[-1], axis_name)
+
+    @jax.custom_vjp
+    def _slice(v):
+        return jax.lax.dynamic_slice_in_dim(v, start, width, axis=v.ndim - 1)
+
+    def _bwd(_, g):
+        return (jax.lax.all_gather(g, axis_name, axis=g.ndim - 1, tiled=True),)
+
+    _slice.defvjp(lambda v: (_slice(v), None), _bwd)
+    return _slice(x)
+
+
+def tp_once(x, axis_name: Optional[str] = None):
+    """Identity forward; divides the cotangent by T. Zero communication.
+
+    For values computed redundantly (bitwise-identically) on every tensor rank
+    from replicated inputs — e.g. the fused kernel's ef_sum/count outputs,
+    which come from the replicated phi_e weights while the same kernel call's
+    trans_sum output is a per-rank partial. Inputs feeding such a kernel are
+    wrapped in tp_copy (bwd psum), which would count the replicated outputs'
+    cotangent T times; tp_once pre-divides so the psum counts it exactly once.
+    Exact (not just approximate) when T is a power of two.
+    """
+    if axis_name is None:
+        return x
+    t = jax.lax.psum(1, axis_name)
+
+    @jax.custom_vjp
+    def _once(v):
+        return v
+
+    _once.defvjp(lambda v: (v, None), lambda _, g: (jax.tree.map(lambda a: a / t, g),))
+    return _once(x)
+
+
+def tp_slice_rows(x, axis_name: Optional[str] = None):
+    """Row-block analogue of tp_slice: 1/T slice of axis 0 (row-parallel W2)."""
+    if axis_name is None:
+        return x
+    width, start = _tp_slice_bounds(x.shape[0], axis_name)
+
+    @jax.custom_vjp
+    def _slice(v):
+        return jax.lax.dynamic_slice_in_dim(v, start, width, axis=0)
+
+    def _bwd(_, g):
+        return (jax.lax.all_gather(g, axis_name, axis=0, tiled=True),)
+
+    _slice.defvjp(lambda v: (_slice(v), None), _bwd)
+    return _slice(x)
+
+
 def global_node_mean(data: jnp.ndarray, mask: jnp.ndarray, axis_name: Optional[str] = None):
     """Exact GLOBAL mean over real nodes of each graph, across all partitions.
 

@@ -1,0 +1,35 @@
+"""jax version compatibility for shard_map.
+
+Newer jax exposes ``jax.shard_map(..., check_vma=...)``; older releases only
+have ``jax.experimental.shard_map.shard_map(..., check_rep=...)``. The
+framework calls through this one wrapper so every distributed entry point
+(launch.py, scan_epoch.py, dryrun parity, tensor-parallel tests) runs on
+either API without version-conditional code at the call sites.
+"""
+
+from __future__ import annotations
+
+import jax
+
+try:  # jax >= 0.6 style
+    _shard_map_new = jax.shard_map  # type: ignore[attr-defined]
+except AttributeError:
+    _shard_map_new = None
+
+if _shard_map_new is None:
+    from jax.experimental.shard_map import shard_map as _shard_map_old
+else:
+    _shard_map_old = None
+
+
+def shard_map(f, mesh, in_specs, out_specs, check_vma: bool = False):
+    """Portable shard_map: replication checking off by default (the manual
+    tensor-axis collectives intentionally produce unreplicated intermediates).
+    """
+    if _shard_map_new is not None:
+        return _shard_map_new(
+            f, mesh=mesh, in_specs=in_specs, out_specs=out_specs, check_vma=check_vma
+        )
+    return _shard_map_old(
+        f, mesh=mesh, in_specs=in_specs, out_specs=out_specs, check_rep=check_vma
+    )

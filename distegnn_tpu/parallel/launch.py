@@ -32,7 +32,8 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 
 from distegnn_tpu import obs
 from distegnn_tpu.obs.jaxprobe import TransferMeter
-from distegnn_tpu.parallel.mesh import DATA_AXIS, GRAPH_AXIS, make_mesh
+from distegnn_tpu.parallel.compat import shard_map
+from distegnn_tpu.parallel.mesh import DATA_AXIS, GRAPH_AXIS, TENSOR_AXIS, make_mesh
 from distegnn_tpu.train import (
     TrainState,
     make_eval_step,
@@ -42,7 +43,11 @@ from distegnn_tpu.train import (
     restore_checkpoint,
     train,
 )
-from distegnn_tpu.train.checkpoint import adopt_resume_seed, resolve_resume
+from distegnn_tpu.train.checkpoint import (
+    adopt_resume_seed,
+    resolve_resume,
+    verify_resume_consensus,
+)
 
 
 def batch_layout(n_data: int):
@@ -97,13 +102,13 @@ def make_distributed_steps(model, tx, mesh, mmd_weight: float, mmd_sigma: float,
     def _eval_one(params, batch):
         return ev(params, jax.tree.map(strip, batch))
 
-    train_step = jax.jit(jax.shard_map(
+    train_step = jax.jit(shard_map(
         _step_one, mesh=mesh,
         in_specs=(P(), batch_spec, P()),
         out_specs=(P(), P()),
         check_vma=False,
     ))
-    eval_step = jax.jit(jax.shard_map(
+    eval_step = jax.jit(shard_map(
         _eval_one, mesh=mesh,
         in_specs=(P(), batch_spec),
         out_specs=P(),
@@ -209,19 +214,28 @@ def run_distributed(config):
     from distegnn_tpu.utils.seed import fix_seed
 
     # world_size = graph partitions (reference semantics); data_parallel adds
-    # the second mesh axis, so ws * dp devices are used. Multi-host: after
-    # jax.distributed.initialize() (main.py --multihost) jax.devices() is the
-    # GLOBAL device list, so the mesh spans all processes with no extra code.
-    dp = int(config.data.get("data_parallel") or 1)
-    ws = config.data.get("world_size") or len(jax.devices()) // dp
-    if ws < 1 or ws * dp > len(jax.devices()):
+    # the second mesh axis and parallel.mesh.tensor the third, so ws * dp * tp
+    # devices are used. Multi-host: after jax.distributed.initialize()
+    # (main.py --multihost) jax.devices() is the GLOBAL device list, so the
+    # mesh spans all processes with no extra code.
+    pmesh = (config.get("parallel") or {}).get("mesh") or {}
+    tp = int(pmesh.get("tensor") or 1)
+    dp = int(pmesh.get("data") or config.data.get("data_parallel") or 1)
+    ws = (pmesh.get("graph") or config.data.get("world_size")
+          or len(jax.devices()) // (dp * tp))
+    ws = int(ws)
+    if ws < 1 or ws * dp * tp > len(jax.devices()):
         raise ValueError(
-            f"world_size {ws} x data_parallel {dp} does not fit the "
+            f"mesh data={dp} x graph={ws} x tensor={tp} does not fit the "
             f"{len(jax.devices())} available devices")
     derive_runtime_fields(config, world_size=ws)
     adopt_resume_seed(config)
     fix_seed(config.seed)
-    mesh = make_mesh(n_graph=ws, n_data=dp, devices=jax.devices()[:ws * dp])
+    mesh = make_mesh(n_graph=ws, n_data=dp, n_tensor=tp,
+                     devices=jax.devices()[:ws * dp * tp])
+    # record the resolved shape so downstream consumers (checkpoint metadata,
+    # per-chip memory gauges) tag artifacts with the actual mesh
+    config.parallel = {"mesh": {"data": dp, "graph": ws, "tensor": tp}}
 
     d = config.data
     name = d.dataset_name
@@ -262,16 +276,22 @@ def run_distributed(config):
     obs.log(f"Data ready: {len(loader_train.loader.loaders[0].dataset)} graphs x "
             f"{ws} partitions x {dp} data shards")
 
-    model = get_model(config.model, world_size=ws, dataset_name=name, axis_name=GRAPH_AXIS)
-    # init outside shard_map on the raw HOST batch (the axis name is unbound
+    model = get_model(config.model, world_size=ws, dataset_name=name,
+                      axis_name=GRAPH_AXIS,
+                      tensor_axis=(TENSOR_AXIS if tp > 1 else None))
+    # init outside shard_map on the raw HOST batch (the axis names are unbound
     # there, and the param tree is identical either way — axis_name only
-    # routes psums); a global jax.Array can't be indexed on one host
+    # routes psums, and tensor_axis slices the SAME full params at compute
+    # time); a global jax.Array can't be indexed on one host
     sample = next(iter(loader_train.loader))
     _, strip0 = batch_layout(dp)
-    params = model.copy(axis_name=None).init(
+    init_model = (model.copy(axis_name=None, tensor_axis=None) if tp > 1
+                  else model.copy(axis_name=None))
+    params = init_model.init(
         jax.random.PRNGKey(config.seed), jax.tree.map(strip0, sample))
     n_params = sum(int(np.prod(p.shape)) for p in jax.tree.leaves(params))
-    obs.log(f"Model: {config.model.model_name}, {n_params} parameters, mesh graph={ws}")
+    obs.log(f"Model: {config.model.model_name}, {n_params} parameters, "
+            f"mesh data={dp} graph={ws} tensor={tp}")
 
     total_steps = config.train.epochs * len(loader_train) // config.train.accumulation_steps
     clip = 0.3 if needs_grad_clip(config) else None
@@ -294,8 +314,12 @@ def run_distributed(config):
         obs.log(f"resume: restored {resumed.path} (epoch {start_epoch} + "
                 f"{start_step_in_epoch} step(s) applied)")
     elif config.model.checkpoint:
-        state, start_epoch, _ = restore_checkpoint(config.model.checkpoint, state)
+        state, start_epoch, _ = restore_checkpoint(
+            config.model.checkpoint, state, config=config)
         obs.log(f"Checkpoint loaded from {config.model.checkpoint} (epoch {start_epoch})")
+    # coordinated-restore barrier: every host must have adopted the same
+    # resume coordinates before any psum'd step runs (no-op single-process)
+    verify_resume_consensus(start_epoch, start_step_in_epoch)
 
     is_fast = config.model.model_name.startswith("Fast")
     mmd_w = config.train.mmd.weight if is_fast else 0.0

@@ -8,6 +8,11 @@ Axes:
       Lay this axis over ICI: it communicates every layer.
   DATA_AXIS  ('data')  — batch data parallelism; gradient psum once per step.
       May span DCN on multi-host pods.
+  TENSOR_AXIS ('tensor') — tensor parallelism over the EGCL hidden dimension
+      (NeutronTP-style feature split): each chip computes a 1/T hidden slice
+      per edge/node block with exactly one gather-or-psum per MLP at the layer
+      boundary. Placed minor-most (innermost ICI ring) because it communicates
+      the most often. T=1 (the default) is bitwise-identical to the 2D mesh.
 
 Multi-host: ``main.py --multihost`` calls jax.distributed.initialize(), then
 this same code builds the mesh from the GLOBAL jax.devices() — shard_map over
@@ -28,17 +33,30 @@ from jax.sharding import Mesh
 
 GRAPH_AXIS = "graph"
 DATA_AXIS = "data"
+TENSOR_AXIS = "tensor"
 
 
-def make_mesh(n_graph: int = 1, n_data: int = 1, devices: Optional[Sequence] = None) -> Mesh:
-    """Build a (graph, data) mesh over the available devices.
+def make_mesh(
+    n_graph: int = 1,
+    n_data: int = 1,
+    n_tensor: int = 1,
+    devices: Optional[Sequence] = None,
+) -> Mesh:
+    """Build a (data, graph, tensor) mesh over the available devices.
 
-    n_graph * n_data must equal the device count used. The graph axis is placed
-    minor (fastest-varying) so partitions of one graph land on ICI-adjacent
-    chips and the per-layer psums stay off DCN.
+    n_data * n_graph * n_tensor must equal the device count used. The tensor
+    axis is placed minor (fastest-varying) so the per-MLP hidden-dim
+    collectives run over the innermost ICI ring; the graph axis comes next so
+    partitions of one graph stay ICI-adjacent and the per-layer psums stay off
+    DCN. The mesh always carries all three axis names — a T=1 tensor axis is
+    size-1 and every collective over it is an identity, so existing 2D configs
+    are bitwise-unchanged.
     """
     devices = list(devices if devices is not None else jax.devices())
-    if n_graph * n_data != len(devices):
-        raise ValueError(f"mesh {n_graph}x{n_data} != {len(devices)} devices")
-    arr = np.asarray(devices).reshape(n_data, n_graph)
-    return Mesh(arr, (DATA_AXIS, GRAPH_AXIS))
+    if n_graph * n_data * n_tensor != len(devices):
+        raise ValueError(
+            f"mesh {n_data}x{n_graph}x{n_tensor} (data x graph x tensor) "
+            f"!= {len(devices)} devices"
+        )
+    arr = np.asarray(devices).reshape(n_data, n_graph, n_tensor)
+    return Mesh(arr, (DATA_AXIS, GRAPH_AXIS, TENSOR_AXIS))

@@ -85,6 +85,88 @@ class RestoredRun:
     path: Optional[str] = None
 
 
+def _mesh_of(config) -> Optional[dict]:
+    """The (data, graph, tensor) mesh shape recorded in a config, as plain
+    ints, or None when the config predates / doesn't carry one. Tolerant of
+    both ConfigDict and plain-dict payload configs."""
+    if not isinstance(config, dict):
+        return None
+    mesh = (config.get("parallel") or {}).get("mesh")
+    if not isinstance(mesh, dict):
+        return None
+    try:
+        return {k: int(mesh.get(k) or 1) for k in ("data", "graph", "tensor")}
+    except (TypeError, ValueError):
+        return None
+
+
+def check_mesh_restore_compat(payload: dict, config=None) -> None:
+    """Cross-mesh restore gate: a checkpoint written under mesh A restores
+    under mesh B. Params are saved FULL (never tensor-sliced — the TP layers
+    slice replicated weights at compute time), so the param tree is invariant
+    in the mesh shape and 'resharding' is a plain load. The one real
+    constraint is that the RESTORING mesh's tensor degree must still divide
+    the saved model's hidden width; violations raise a typed ValueError here
+    instead of surfacing as a shape error deep inside shard_map."""
+    saved_mesh = payload.get("mesh") or _mesh_of(payload.get("config"))
+    target_mesh = _mesh_of(config)
+    if target_mesh is None:
+        return
+    tp = target_mesh["tensor"]
+    saved_cfg = payload.get("config") or {}
+    model_cfg = saved_cfg.get("model") if isinstance(saved_cfg, dict) else None
+    hidden = (model_cfg or {}).get("hidden_nf")
+    if tp > 1 and hidden is not None and int(hidden) % tp != 0:
+        raise ValueError(
+            f"checkpoint incompatible with mesh: saved hidden_nf={hidden} is "
+            f"not divisible by restoring parallel.mesh.tensor={tp}")
+    if saved_mesh is not None and saved_mesh != target_mesh:
+        obs.event("ckpt/reshard", saved=saved_mesh, target=target_mesh)
+        obs.log(f"restore: resharding checkpoint saved under mesh {saved_mesh} "
+                f"onto mesh {target_mesh} (params are full/replicated — "
+                "plain load)")
+
+
+def verify_resume_consensus(epoch: int, step_in_epoch: int,
+                            allgather=None) -> None:
+    """Multi-host coordinated-restore barrier (closes the docs/ROBUSTNESS.md
+    'Known gap'): each process resolves its resume checkpoint independently
+    from its own filesystem view, so a half-propagated checkpoint directory
+    (NFS lag, partial rsync) can leave hosts resuming from DIFFERENT steps —
+    silently corrupting gradient averaging, since psum assumes every host
+    holds the same params. After restore, every process publishes the
+    (epoch, step_in_epoch) it adopted; any disagreement fails loudly here,
+    before a single step runs.
+
+    ``allgather`` is injectable for single-process tests: a callable taking
+    the local ``np.ndarray([epoch, step_in_epoch])`` and returning the
+    [n_process, 2] stack. Default uses
+    ``jax.experimental.multihost_utils.process_allgather``; single-process
+    runs with the default are a no-op."""
+    if allgather is None:
+        if jax.process_count() == 1:
+            return
+        from jax.experimental import multihost_utils
+
+        def allgather(x):
+            return np.asarray(multihost_utils.process_allgather(x))
+
+    local = np.asarray([int(epoch), int(step_in_epoch)], dtype=np.int64)
+    coords = np.asarray(allgather(local)).reshape(-1, 2)
+    uniq = {tuple(int(v) for v in row) for row in coords}
+    obs.event("resume/consensus", epoch=int(epoch),
+              step_in_epoch=int(step_in_epoch), n_views=len(uniq))
+    if len(uniq) > 1:
+        views = ", ".join(
+            f"process {i}: epoch={int(r[0])} step_in_epoch={int(r[1])}"
+            for i, r in enumerate(coords))
+        raise RuntimeError(
+            "resume consensus failure: hosts adopted different resume "
+            f"coordinates ({views}). A half-propagated checkpoint directory "
+            "is the usual cause — make every host see the same state_dict/ "
+            "contents, then relaunch.")
+
+
 def _to_leaves(tree) -> list:
     return [np.asarray(x) for x in jax.tree.leaves(tree)]
 
@@ -171,6 +253,10 @@ def save_checkpoint(path: str, state, epoch: int, losses: Optional[dict] = None,
         "seed": None if seed is None else int(seed),
         "losses": losses or {},
         "config": config,
+        # the (data, graph, tensor) shape this run trained under — restore
+        # under any other shape is legal (params are full), the metadata
+        # feeds the reshard log + compat check (check_mesh_restore_compat)
+        "mesh": _mesh_of(config),
     }
     ckpt_dir = os.path.dirname(path) or "."
     os.makedirs(ckpt_dir, exist_ok=True)
@@ -266,16 +352,20 @@ def _with_config_hint(payload, e: ValueError) -> ValueError:
     return ValueError(f"{e}{hint}")
 
 
-def restore_for_resume(path: str, state) -> RestoredRun:
+def restore_for_resume(path: str, state, config=None) -> RestoredRun:
     """Verified restore into the structure of ``state`` (a freshly-created
     TrainState), carrying the resume coordinates (epoch, step_in_epoch, seed).
     The optimizer configuration must match the one the checkpoint was written
     with (grad-accumulation wrapping changes the opt-state tree);
-    evaluation-only consumers should use :func:`restore_params` instead."""
+    evaluation-only consumers should use :func:`restore_params` instead.
+    With ``config`` given, the checkpoint's recorded mesh is checked against
+    the restoring mesh (:func:`check_mesh_restore_compat`)."""
     import time as _time
 
     t0 = _time.perf_counter()
     payload = verify_checkpoint(path)
+    if config is not None:
+        check_mesh_restore_compat(payload, config)
     from distegnn_tpu.train.step import TrainState
 
     try:
@@ -300,10 +390,10 @@ def restore_for_resume(path: str, state) -> RestoredRun:
     )
 
 
-def restore_checkpoint(path: str, state) -> tuple[Any, int, dict]:
+def restore_checkpoint(path: str, state, config=None) -> tuple[Any, int, dict]:
     """Back-compat wrapper over :func:`restore_for_resume`: returns
     (state, start_epoch, losses)."""
-    r = restore_for_resume(path, state)
+    r = restore_for_resume(path, state, config=config)
     return r.state, r.epoch, r.losses
 
 
@@ -346,14 +436,14 @@ def peek_resume_seed(log_dir: str):
     return None, None
 
 
-def find_resume_checkpoint(log_dir: str, state) -> Optional[RestoredRun]:
+def find_resume_checkpoint(log_dir: str, state, config=None) -> Optional[RestoredRun]:
     """``train.resume: auto``: scan the experiment log dir, verify checksums,
     and restore the NEWEST valid checkpoint — falling back past corrupt /
     truncated / architecture-incompatible files with a printed diagnosis.
     Returns None when nothing under ``log_dir`` restores (fresh start)."""
     for path in scan_resume_candidates(log_dir):
         try:
-            return restore_for_resume(path, state)
+            return restore_for_resume(path, state, config=config)
         except CheckpointCorruptError as e:
             obs.log(f"resume: skipping {path} ({e.reason})")
         except ValueError as e:
@@ -390,12 +480,12 @@ def resolve_resume(config, state) -> Optional[RestoredRun]:
     if not resume:
         return None
     if resume == "auto":
-        rr = find_resume_checkpoint(config.log.log_dir, state)
+        rr = find_resume_checkpoint(config.log.log_dir, state, config=config)
         if rr is None:
             obs.log("resume: auto found no valid checkpoint under "
                     f"{config.log.log_dir}; starting fresh")
         return rr
-    return restore_for_resume(resume, state)
+    return restore_for_resume(resume, state, config=config)
 
 
 def write_preempt_marker(ckpt_dir: str, ckpt_name: str, epoch: int,

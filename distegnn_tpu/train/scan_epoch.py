@@ -32,6 +32,7 @@ import numpy as np
 
 from distegnn_tpu.data.loader import GraphLoader, ShardedGraphLoader
 from distegnn_tpu.ops.graph import GraphBatch, pad_graphs
+from distegnn_tpu.parallel.compat import shard_map
 from distegnn_tpu.parallel.mesh import DATA_AXIS, GRAPH_AXIS
 
 
@@ -317,13 +318,13 @@ class DistributedScanRunner:
             _, losses = jax.lax.scan(body, None, perm)
             return jnp.mean(losses)
 
-        self._run_train = jax.jit(jax.shard_map(
+        self._run_train = jax.jit(shard_map(
             run_train, mesh=mesh,
             in_specs=(P(), data_spec, perm_spec, P()),
             out_specs=(P(), P(), P()), check_vma=False))
         self._run_eval = None
         if device_eval_step is not None:
-            self._run_eval = jax.jit(jax.shard_map(
+            self._run_eval = jax.jit(shard_map(
                 run_eval, mesh=mesh,
                 in_specs=(P(), data_spec, perm_spec),
                 out_specs=P(), check_vma=False))

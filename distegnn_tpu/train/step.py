@@ -96,7 +96,14 @@ def _reduce_axes(axis_name, data_axis_name):
     """All mesh axes the LOSS/GRADIENT reduce over: graph partitions and (when
     2-D) data-parallel shards. The model's virtual-node psums stay on
     ``axis_name`` alone — virtual nodes are per-graph objects, and the data
-    axis holds *different* graphs."""
+    axis holds *different* graphs.
+
+    The TENSOR axis is deliberately absent: the TP collectives' custom VJPs
+    (parallel/collectives.py) already hand every tensor rank the FULL
+    parameter cotangent (tensor-replicated, each loss term counted once), so
+    the loss is replicated across tensor ranks and this psum over
+    (data, graph) is exact unchanged for any tensor degree. Adding the tensor
+    axis here would T-fold double-count gradients."""
     axes = tuple(a for a in (data_axis_name, axis_name) if a is not None)
     return axes if axes else None
 

@@ -54,18 +54,52 @@ class PreemptionGuard:
     raises elsewhere — e.g. trainer invocations inside test harness threads),
     and the previous handlers are restored by :meth:`uninstall`.
 
-    Multi-host note: each process reacts to ITS OWN signal; process 0 writes
-    the checkpoint. A coordinated cross-host stop barrier is a known gap
-    (ROADMAP open items)."""
+    Multi-host: each process reacts to ITS OWN signal, but the stop decision
+    is COORDINATED — :meth:`stop_agreed` allgathers the local flag at every
+    step boundary, so a SIGTERM delivered to one host (preemption notices
+    rarely reach all hosts in the same step) stops every host after the SAME
+    completed step. The flag is armed by the signal handler and observed one
+    step later at the shared boundary; hosts that never saw a signal adopt
+    the remote request, so the (epoch, step_in_epoch) recorded in the
+    preempt checkpoint is a single cross-host value — which resume then
+    verifies with checkpoint.verify_resume_consensus. ``allgather`` is
+    injectable for single-process drills (tests/test_tensor_parallel.py)."""
 
     SIGNALS = (signal.SIGTERM, signal.SIGINT)
 
-    def __init__(self):
+    def __init__(self, allgather=None):
         self.requested = False
         self.signum: Optional[int] = None
         self.interrupted = False   # set by run_epoch_train on a mid-epoch break
         self.steps_done = 0        # steps of the current epoch applied at break
         self._prev: dict = {}
+        self._allgather = allgather  # None -> multihost_utils when multi-host
+
+    def stop_agreed(self) -> bool:
+        """The cross-host stop barrier, called between steps: True iff ANY
+        process has a stop request. Single-process with no injected
+        allgather this is the plain local flag (no collective)."""
+        ag = self._allgather
+        if ag is None:
+            if jax.process_count() == 1:
+                return self.requested
+            from jax.experimental import multihost_utils
+
+            def ag(x):
+                return np.asarray(multihost_utils.process_allgather(x))
+
+        flags = np.asarray(
+            ag(np.asarray([1 if self.requested else 0], dtype=np.int32))
+        ).reshape(-1)
+        agreed = bool(flags.any())
+        if agreed and not self.requested:
+            # adopt the remote host's request so this host checkpoints the
+            # same (epoch, step) coordinates and exits resumable too
+            self.requested = True
+            self.signum = self.signum or signal.SIGTERM
+            obs.log("preemption: adopting a remote host's stop request at "
+                    "the step barrier")
+        return agreed
 
     def _handle(self, signum, frame):
         if self.requested:  # second signal: give up on the graceful path
@@ -197,7 +231,7 @@ def run_epoch_train(train_step: Callable, state, loader, seed: int, epoch: int,
                 cadence.maybe_save(state, epoch, 0)
             else:
                 cadence.maybe_save(state, epoch - 1, step_idx + 1)
-        if guard is not None and guard.requested:
+        if guard is not None and guard.stop_agreed():
             guard.interrupted = True
             guard.steps_done = step_idx + 1
             break
@@ -292,10 +326,19 @@ def train(
         tags={"run": log_cfg.get("exp_name", "run")})
     step_events = bool(obs_cfg.get("step_events", True))
     stall_c = obs.get_registry().counter("data/stall_s")
+    # mesh tag for the per-chip memory gauges: the (data, graph, tensor)
+    # shape the run resolved (launch.py records it; single-device runs
+    # default to 1x1x1), so HBM numbers are comparable ACROSS mesh shapes
+    pmesh = (config.get("parallel") or {}).get("mesh") or {}
+    mesh_tag = "x".join(str(int(pmesh.get(k) or 1))
+                        for k in ("data", "graph", "tensor"))
     tracer.event("train/run_start", start_epoch=start_epoch,
                  epochs=int(train_cfg.epochs),
                  scan_epochs=scan_runner is not None,
-                 devices=jax.device_count(), processes=jax.process_count())
+                 devices=jax.device_count(), processes=jax.process_count(),
+                 mesh=mesh_tag)
+    jaxprobe.emit_memory_event(tracer, phase="run_start", mesh=mesh_tag)
+    jaxprobe.record_memory_gauges("run_start")
     if start_epoch or start_step_in_epoch:
         tracer.event("train/resume", epoch=start_epoch,
                      step_in_epoch=int(start_step_in_epoch or 0))
@@ -458,7 +501,7 @@ def train(
             # preemption at an epoch boundary (scan-runner epochs, or the signal
             # landed on the last step): checkpoint the completed epoch and exit
             # BEFORE eval — a SIGTERM grace window is seconds, not an eval epoch
-            if guard.requested:
+            if guard.stop_agreed():
                 _preempt_exit(epoch, 0)
                 break
 
@@ -480,6 +523,13 @@ def train(
                     # compile a true (alarm-worthy) recompile
                     warmup_marked = True
                     jaxprobe.mark_warmup_done()
+                    # steady-state HBM snapshot: both compiled programs have
+                    # run, so peak_bytes_in_use now covers the real footprint
+                    # — paired with the run_start gauge, the delta is what a
+                    # T-way tensor shard is supposed to shrink
+                    jaxprobe.emit_memory_event(tracer, phase="post_warmup",
+                                               mesh=mesh_tag)
+                    jaxprobe.record_memory_gauges("post_warmup")
                 if log_cfg.get("check_consistency", True):
                     from distegnn_tpu.parallel.checks import assert_replicated
 

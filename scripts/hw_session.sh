@@ -307,8 +307,26 @@ EOF
   cp /tmp/bench_fused_last.json \
      "docs/artifacts/bench_fused_$(date -u +%Y%m%dT%H%M%S).json"
 }
-export -f fused_leg_and_check bench_and_check  # run_bounded's bash -c needs them
+# 0b. 3-axis mesh leg: the tensor-parallel hidden-dim split (parallel.mesh,
+#     docs/PERFORMANCE.md "3D mesh") timed on real chips — data=1 x graph=1 x
+#     tensor=2 so it fits any 2+-chip tunnel slice. Bounded like every other
+#     leg; failure (single-chip slice, wedge) records in seconds and the
+#     queue moves on. CPU parity for the same leg lives in tier-1
+#     (tests/test_bench_unlosable.py + tests/test_tensor_parallel.py).
+mesh3d_leg_and_check() {
+  python bench.py --mesh 1x1x2 | tee /tmp/bench_mesh3d_last.json
+  python - <<'EOF' || return 1
+import json
+line = [l for l in open('/tmp/bench_mesh3d_last.json') if l.strip().startswith('{')][-1]
+raise SystemExit(0 if json.loads(line)['value'] > 0 else 1)
+EOF
+  mkdir -p docs/artifacts
+  cp /tmp/bench_mesh3d_last.json \
+     "docs/artifacts/bench_mesh3d_$(date -u +%Y%m%dT%H%M%S).json"
+}
+export -f mesh3d_leg_and_check fused_leg_and_check bench_and_check  # run_bounded's bash -c needs them
 run_bounded bench_fused fused_leg_and_check
+run_bounded bench_mesh3d mesh3d_leg_and_check
 # 1. headline bench: auto races fused / plain-cumsum stacks / plain-scatter
 #    anchor in child processes (bench.RACE_ORDER) and reports the fastest
 #    real measurement

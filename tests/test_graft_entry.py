@@ -25,8 +25,11 @@ def test_entry_forward_jits():
 
 def test_dryrun_multichip_8():
     # asserts internally (finiteness, metis unevenness); conftest provides
-    # the 8 virtual CPU devices the driver's env would
-    graft_entry.dryrun_multichip(8)
+    # the 8 virtual CPU devices the driver's env would. The dryrun's 3D-mesh
+    # tensor-parity leg is skipped here ONLY because tier-1 already runs it
+    # as dedicated cases (test_tensor_parallel.py parity tests) — paying for
+    # it twice would push the suite past its wall budget.
+    graft_entry.dryrun_multichip(8, tensor_parity=False)
 
 
 def test_bench_cpu_competitors_classification(tmp_path):

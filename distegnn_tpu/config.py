@@ -202,6 +202,24 @@ _DEFAULTS: dict = {
         # skips Morton relabel + blocked re-pack + remote classify for
         # repeat-topology requests (prep_ms ~ gather-only).
         "session_cache": 64,
+        # shared-nothing engine replicas per model (serve/replica.py): each
+        # replica owns its own engine + dispatcher queue behind one
+        # round-robin ReplicaSet; >= 2 enables failover of in-flight
+        # requests when a replica crashes or wedges
+        "replicas": 1,
+        # replica supervisor knobs (serve/supervisor.py): heartbeat cadence,
+        # wedge (no batch progress) deadline, restart exponential backoff,
+        # and the per-replica circuit breaker. Keys are splatted into
+        # ReplicaSupervisor(**...), so only these seven are accepted.
+        "supervisor": {
+            "heartbeat_s": 0.25,
+            "wedge_timeout_s": 60.0,
+            "backoff_base_s": 0.5,
+            "backoff_max_s": 30.0,
+            "breaker_threshold": 3,
+            "breaker_cooldown_s": 30.0,
+            "healthy_reset_s": 60.0,
+        },
         # multi-model routing (serve/registry.py): null = one model from
         # THIS config; else a list of {name, config_path?, overrides?}
         # entries, each owning its own engine + queue + warmup
@@ -514,6 +532,25 @@ def validate_config(cfg: ConfigDict) -> None:
                 * int(r.get("edge_block", 256))) % 512:
             raise ValueError("serve.rollout: max_degree * edge_block must be "
                              "a multiple of 512 (the kernel edge tile)")
+    if int(s.get("replicas", 1) or 1) < 1:
+        raise ValueError("serve.replicas must be >= 1")
+    sup = s.get("supervisor")
+    if sup is not None:
+        if not isinstance(sup, Mapping):
+            raise ValueError("serve.supervisor must be null or a mapping of "
+                             "ReplicaSupervisor kwargs")
+        known = ("heartbeat_s", "wedge_timeout_s", "backoff_base_s",
+                 "backoff_max_s", "breaker_threshold", "breaker_cooldown_s",
+                 "healthy_reset_s")
+        for key in sup:
+            if key not in known:
+                raise ValueError(f"serve.supervisor: unknown key {key!r} "
+                                 f"(accepted: {', '.join(known)})")
+        for key in known:
+            if key in sup and float(sup[key]) <= 0:
+                raise ValueError(f"serve.supervisor.{key} must be > 0")
+        if int(sup.get("breaker_threshold", 3)) < 1:
+            raise ValueError("serve.supervisor.breaker_threshold must be >= 1")
     models = s.get("models")
     if models is not None:
         if not isinstance(models, (list, tuple)) or not models:

@@ -14,14 +14,20 @@ from distegnn_tpu.serve.engine import (InferenceEngine,
                                        RolloutOverflowError)
 from distegnn_tpu.serve.metrics import ServeMetrics
 from distegnn_tpu.serve.prep import PrepPlan, PrepResult, SessionPrepCache
-from distegnn_tpu.serve.queue import (QueueFullError, RequestQueue,
-                                      RequestTimeoutError, ServeFuture)
+from distegnn_tpu.serve.queue import (DispatcherCrashError, QueueFullError,
+                                      RequestQueue, RequestTimeoutError,
+                                      ServeFuture)
+from distegnn_tpu.serve.replica import (ModelUnavailableError, Replica,
+                                        ReplicaSet)
+from distegnn_tpu.serve.supervisor import ReplicaSupervisor
 
 __all__ = [
     "Bucket", "BucketLadder", "BucketOverflowError", "synthetic_graph",
     "InferenceEngine", "MixedRolloutStepsError", "RolloutOverflowError",
     "ServeMetrics", "PrepPlan", "PrepResult", "SessionPrepCache",
     "QueueFullError", "RequestQueue", "RequestTimeoutError", "ServeFuture",
+    "DispatcherCrashError", "ModelUnavailableError", "Replica", "ReplicaSet",
+    "ReplicaSupervisor", "SwapError", "SwapInProgressError",
     "engine_from_config", "Gateway", "ModelEntry", "ModelRegistry",
     "PayloadError",
 ]
@@ -35,7 +41,8 @@ def __getattr__(name):
         from distegnn_tpu.serve import transport
 
         return getattr(transport, name)
-    if name in ("ModelEntry", "ModelRegistry"):
+    if name in ("ModelEntry", "ModelRegistry", "SwapError",
+                "SwapInProgressError"):
         from distegnn_tpu.serve import registry
 
         return getattr(registry, name)

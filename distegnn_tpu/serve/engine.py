@@ -52,6 +52,11 @@ class RolloutOverflowError(RuntimeError):
     (max_per_cell / max_degree) — results would silently drop edges."""
 
 
+class CanaryError(RuntimeError):
+    """The blue/green canary forward pass rejected candidate params
+    (non-finite outputs or a shape mismatch) — the swap must roll back."""
+
+
 class MixedRolloutStepsError(ValueError):
     """A rollout micro-batch mixed different steps-K. The scan length is
     static (part of the compiled executable), so scenes with different K can
@@ -223,6 +228,50 @@ class InferenceEngine:
 
     def _probe_edge_attr_nf(self) -> int:
         return int(getattr(self.model, "edge_attr_nf", 2) or 0)
+
+    @property
+    def rollout_enabled(self) -> bool:
+        """True when the engine was built with rollout_opts — the public
+        capability flag the registry/transport consult instead of reaching
+        into ``_rollout_opts``."""
+        return bool(self._rollout_opts)
+
+    # ---- blue/green canary ----------------------------------------------
+    def canary(self, params, buckets: Sequence[Bucket]) -> int:
+        """Forward CANDIDATE params through each bucket's compiled
+        executable on a synthetic graph, without flipping ``self.params``.
+
+        Reuses the exact predict compile-cache keys, so canarying warmed
+        rungs compiles nothing new. Raises :class:`CanaryError` on
+        non-finite outputs or a shape mismatch; returns the number of rungs
+        checked. Used by the registry's blue/green swap before a replica is
+        flipped to new params.
+        """
+        from distegnn_tpu.serve.buckets import synthetic_graph
+
+        g = synthetic_graph(2, seed=0, feat_nf=self._probe_feat_nf(),
+                            edge_attr_nf=self._probe_edge_attr_nf())
+        checked = 0
+        for b in buckets or [self.ladder.bucket_of_graph(g)]:
+            batch, _ = self.ladder.pad_batch([g], b, self.max_batch,
+                                             **self._layout_opts)
+            rpad = (batch.remote_edge_mask.shape[-1]
+                    if batch.remote_edge_mask is not None else 0)
+            fn = self._compiled(("predict", batch.max_nodes, batch.max_edges,
+                                 batch.edge_block, rpad, self.max_batch),
+                                lambda: self._build_predict(b))
+            out = np.asarray(fn(params, batch))
+            if out.shape != (self.max_batch, batch.max_nodes, 3):
+                raise CanaryError(
+                    f"canary output shape {out.shape} != expected "
+                    f"{(self.max_batch, batch.max_nodes, 3)} on rung {b}")
+            n_real = int(g["loc"].shape[0])
+            if not np.isfinite(out[0, :n_real]).all():
+                raise CanaryError(
+                    f"canary produced non-finite outputs on rung {b} "
+                    f"(candidate params are poisoned)")
+            checked += 1
+        return checked
 
     # ---- K-step rollout --------------------------------------------------
     def _rollout_fn_opts(self) -> dict:

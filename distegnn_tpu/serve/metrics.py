@@ -26,7 +26,8 @@ from distegnn_tpu.obs.metrics import percentile as _percentile  # noqa: F401
 _COUNTERS = (
     "requests_submitted", "requests_completed", "requests_failed",
     "requests_timeout", "requests_rejected", "requests_retried",
-    "requests_poison", "worker_restarts", "batches_executed",
+    "requests_poison", "worker_restarts", "requests_failed_over",
+    "replica_restarts", "batches_executed",
     "batch_slots_total", "batch_slots_filled",
     "cache_hits", "cache_misses", "cache_evictions",
     "session_hits", "session_misses", "session_evictions",
@@ -88,6 +89,16 @@ class ServeMetrics:
     def worker_restarted(self, n: int = 1) -> None:
         self._c["worker_restarts"].add(n)
 
+    def failed_over(self, n: int = 1) -> None:
+        """A dead replica's in-flight request was re-dispatched to a
+        survivor (the replica layer's at-most-once failover)."""
+        self._c["requests_failed_over"].add(n)
+
+    def replica_restarted(self, n: int = 1) -> None:
+        """The supervisor restarted a crashed/wedged replica (distinct from
+        ``worker_restarts``, the in-queue dispatcher crash containment)."""
+        self._c["replica_restarts"].add(n)
+
     def batch_done(self, filled: int, capacity: int,
                    latencies_ms: List[float],
                    queue_ms_each: Optional[List[float]] = None) -> None:
@@ -132,6 +143,8 @@ class ServeMetrics:
             "requests_retried": c["requests_retried"],
             "requests_poison": c["requests_poison"],
             "worker_restarts": c["worker_restarts"],
+            "requests_failed_over": c["requests_failed_over"],
+            "replica_restarts": c["replica_restarts"],
             "requests_per_sec": round(c["requests_completed"] / elapsed, 3),
             "batches_executed": c["batches_executed"],
             "batch_fill_ratio": round(fill, 4),

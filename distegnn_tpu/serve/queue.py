@@ -21,9 +21,17 @@ rollout.py):
   - engine/model exception  -> each request of the batch is RETRIED ALONE
     once (one poison graph must not take down co-batched neighbors); only
     requests that fail solo get the exception (counted as ``poison``)
-  - dispatcher thread crash -> restarted up to ``_MAX_WORKER_RESTARTS``
-    times (pending requests survive), then every outstanding future fails
-    with the crash error and submit() raises — never a silent hang
+  - dispatcher thread crash -> restarted with exponential backoff, up to
+    ``_MAX_WORKER_RESTARTS`` times within ``_RESTART_WINDOW_S`` (the budget
+    replenishes after a healthy interval, so transient crashes spread over
+    hours never exhaust it); past the budget every outstanding future fails
+    with :class:`DispatcherCrashError` and submit() raises — never a silent
+    hang
+
+The queue also carries the serving chaos surface (``kill`` / ``wedge`` /
+``inject_latency``) used by the replica supervisor and the fault-injection
+harness, plus a ``last_progress`` heartbeat timestamp the supervisor reads
+to detect wedged dispatchers (depth > 0 with no batch progress).
 
 Device execution runs inline in the dispatcher thread: the accelerator is a
 serial resource, so a thread pool would only add queueing ambiguity. The
@@ -51,6 +59,17 @@ class RequestTimeoutError(RuntimeError):
     """The request's deadline passed before a batch picked it up."""
 
 
+class DispatcherCrashError(RuntimeError):
+    """The dispatcher died permanently (crash budget exhausted, or killed by
+    chaos / the replica supervisor). Outstanding futures carry this error so
+    the replica layer can tell a dead dispatcher (fail over the request)
+    from a per-request failure (propagate to the caller)."""
+
+
+class _KilledError(Exception):
+    """Internal control flow: the dispatcher observed its kill flag."""
+
+
 class ServeFuture:
     """Minimal one-shot future (no asyncio dependency in the serving core).
 
@@ -61,6 +80,11 @@ class ServeFuture:
     gateway's 504) instead of a hung caller. ``meta`` is filled by the
     dispatcher before resolution (queue_ms / compute_ms / batch_filled /
     bucket) for transports that report per-request timing.
+
+    Resolution is FIRST-WINS: once resolved, later ``set_result`` /
+    ``set_exception`` calls are ignored (and return False). The replica
+    layer relies on this for at-most-once failover — a late result from an
+    abandoned wedged replica can't clobber the failover's answer.
     """
 
     def __init__(self, hard_deadline: Optional[float] = None):
@@ -68,18 +92,51 @@ class ServeFuture:
         self._result = None
         self._exc: Optional[BaseException] = None
         self._hard_deadline = hard_deadline
+        self._lock = threading.Lock()
+        self._callbacks: List = []
         self.meta: dict = {}
 
-    def set_result(self, value) -> None:
-        self._result = value
-        self._event.set()
+    def _resolve(self, value, exc: Optional[BaseException]) -> bool:
+        with self._lock:
+            if self._event.is_set():
+                return False  # first resolution wins
+            self._result = value
+            self._exc = exc
+            self._event.set()
+            callbacks, self._callbacks = self._callbacks, []
+        for fn in callbacks:
+            try:
+                fn(self)
+            except Exception as cb_exc:
+                obs.log(f"serve: future callback raised: {cb_exc!r}")
+        return True
 
-    def set_exception(self, exc: BaseException) -> None:
-        self._exc = exc
-        self._event.set()
+    def set_result(self, value) -> bool:
+        return self._resolve(value, None)
+
+    def set_exception(self, exc: BaseException) -> bool:
+        return self._resolve(None, exc)
+
+    def add_done_callback(self, fn) -> None:
+        """Run ``fn(self)`` when the future resolves (immediately if it
+        already has). Callbacks fire in the resolving thread; exceptions are
+        logged, never propagated into the dispatcher."""
+        with self._lock:
+            if not self._event.is_set():
+                self._callbacks.append(fn)
+                return
+        try:
+            fn(self)
+        except Exception as cb_exc:
+            obs.log(f"serve: future callback raised: {cb_exc!r}")
 
     def done(self) -> bool:
         return self._event.is_set()
+
+    def exception(self) -> Optional[BaseException]:
+        """Non-blocking peek at the resolved exception (None if pending or
+        resolved with a result)."""
+        return self._exc if self._event.is_set() else None
 
     def result(self, timeout: Optional[float] = None):
         if timeout is None and self._hard_deadline is not None:
@@ -131,11 +188,18 @@ def _request_ids(reqs: List["_Request"]) -> List[Optional[str]]:
 
 
 _STOP = object()
+_KILL = object()  # chaos/supervisor kill marker — wakes a blocked ingress.get
 
 # dispatcher crash tolerance: a crashing _loop (a BUG, not an engine error —
-# those are caught per-batch) restarts this many times before the queue
-# declares itself dead and fails everything outstanding
+# those are caught per-batch) restarts with exponential backoff; only crashes
+# within _RESTART_WINDOW_S count against the budget, so the budget replenishes
+# after a healthy interval and 3 transient crashes spread over hours never
+# kill the queue — but a tight crash loop still dies after
+# _MAX_WORKER_RESTARTS + 1 total crashes instead of spinning forever
 _MAX_WORKER_RESTARTS = 3
+_RESTART_WINDOW_S = 60.0
+_RESTART_BACKOFF_BASE_S = 0.05
+_RESTART_BACKOFF_MAX_S = 2.0
 
 
 class RequestQueue:
@@ -171,7 +235,13 @@ class RequestQueue:
         self._pending: Dict[tuple, List[_Request]] = {}
         self._thread: Optional[threading.Thread] = None
         self._started = False
-        self._restarts = 0
+        self._restarts = 0             # lifetime crash count (informational)
+        self._crash_times: List[float] = []  # windowed restart budget
+        # chaos / supervision surface
+        self._kill_reason: Optional[str] = None
+        self._wedge_until = 0.0
+        self._inject_latency_s = 0.0
+        self.last_progress = time.perf_counter()
         # stop() coordination: idempotent and signal-safe — any number of
         # threads (SIGTERM handler, bench atexit, with-block) may race it
         self._stop_lock = threading.Lock()
@@ -198,9 +268,12 @@ class RequestQueue:
         t = self._thread
         return bool(self._started and t is not None and t.is_alive())
 
-    def stop(self, drain: bool = True) -> None:
+    def stop(self, drain: bool = True, join_timeout_s: float = 30.0) -> None:
         """Stop the dispatcher. ``drain=True`` flushes everything already
         admitted; False fails pending futures with RequestTimeoutError.
+        ``join_timeout_s`` bounds the wait for the dispatcher thread — the
+        registry's concurrent drain passes its per-model grace slice so one
+        wedged queue can't eat every model's budget.
 
         Idempotent and signal-safe: double-stop, stop-before-start, and
         concurrent stops (the gateway's SIGTERM drain racing a bench's
@@ -226,7 +299,7 @@ class RequestQueue:
                     break
                 except _pyqueue.Full:
                     continue
-        thread.join(timeout=30.0)
+        thread.join(timeout=join_timeout_s)
         if first:
             # a submit racing the final drain check could leave a request in
             # the ingress after the dispatcher exited — fail it, never
@@ -298,29 +371,77 @@ class RequestQueue:
     def depth(self) -> int:
         return self._ingress.qsize() + sum(len(v) for v in self._pending.values())
 
+    # ---- chaos / supervision hooks ---------------------------------------
+    def kill(self, reason: str = "killed") -> None:
+        """Abruptly and permanently kill the dispatcher (chaos harness; also
+        how the supervisor abandons a wedged replica). Every outstanding
+        future fails with :class:`DispatcherCrashError` immediately — even if
+        the dispatcher thread is stuck inside a device call — and the queue
+        rejects further submits. No restart budget applies: a killed queue
+        stays dead; the replica supervisor builds a fresh one."""
+        self._kill_reason = str(reason)
+        self._started = False
+        self._fail_all(DispatcherCrashError(
+            f"dispatcher killed: {self._kill_reason}"))
+        # after the drain so _fail_all can't consume the wake-up marker
+        try:
+            self._ingress.put_nowait(_KILL)  # wake a blocked ingress.get
+        except _pyqueue.Full:
+            pass
+
+    def wedge(self, duration_s: float) -> None:
+        """Chaos: make the dispatcher sit without batch progress for
+        ``duration_s`` — admitted requests pile up and ``last_progress``
+        goes stale, exactly what a stuck device call looks like to the
+        supervisor."""
+        self._wedge_until = time.perf_counter() + float(duration_s)
+
+    def inject_latency(self, seconds: float) -> None:
+        """Chaos: add a fixed sleep before every batch execute (0 clears)."""
+        self._inject_latency_s = max(float(seconds), 0.0)
+
     # ---- dispatcher ------------------------------------------------------
     def _run(self) -> None:
         """Thread target: _loop with crash containment. Engine errors are
         handled per-batch inside _execute; anything escaping _loop is a bug —
-        restart the loop (pending state survives on the instance) a bounded
-        number of times, then fail everything outstanding and mark the queue
-        dead so submit() raises instead of hanging until timeout."""
+        restart the loop (pending state survives on the instance) with
+        exponential backoff, budgeted over a sliding window (the budget
+        replenishes after a healthy interval), then fail everything
+        outstanding and mark the queue dead so submit() raises instead of
+        hanging until timeout."""
         while True:
             try:
                 self._loop()
                 return  # clean exit (stop/drain)
+            except _KilledError:
+                self._die(DispatcherCrashError(
+                    f"dispatcher killed: {self._kill_reason}"))
+                return
             except Exception as exc:
+                now = time.perf_counter()
+                self._crash_times = [t for t in self._crash_times
+                                     if now - t < _RESTART_WINDOW_S]
+                self._crash_times.append(now)
                 self._restarts += 1
                 self.metrics.worker_restarted()
-                if self._restarts > _MAX_WORKER_RESTARTS:
+                burst = len(self._crash_times)
+                if burst > _MAX_WORKER_RESTARTS:
                     obs.log(f"serve: dispatcher died permanently after "
-                            f"{_MAX_WORKER_RESTARTS} restarts: {exc!r}")
-                    self._fail_all(RuntimeError(
+                            f"{_MAX_WORKER_RESTARTS} restarts in "
+                            f"{_RESTART_WINDOW_S:.0f} s: {exc!r}")
+                    self._die(DispatcherCrashError(
                         f"serve dispatcher crashed: {exc!r}"))
-                    self._started = False
                     return
-                obs.log(f"serve: dispatcher crashed ({exc!r}); restarting "
-                        f"({self._restarts}/{_MAX_WORKER_RESTARTS})")
+                backoff = min(_RESTART_BACKOFF_BASE_S * (2 ** (burst - 1)),
+                              _RESTART_BACKOFF_MAX_S)
+                obs.log(f"serve: dispatcher crashed ({exc!r}); restart "
+                        f"{burst}/{_MAX_WORKER_RESTARTS} in "
+                        f"{backoff * 1e3:.0f} ms")
+                time.sleep(backoff)
+
+    def _die(self, exc: BaseException) -> None:
+        self._fail_all(exc)
+        self._started = False
 
     def _next_flush_deadline(self) -> Optional[float]:
         ts = [rs[0].t_submit + self.batch_deadline
@@ -329,6 +450,8 @@ class RequestQueue:
 
     def _absorb(self, item) -> bool:
         """Move one ingress item into pending; returns True on _STOP."""
+        if item is _KILL:
+            raise _KilledError()
         if isinstance(item, tuple) and item[0] is _STOP:
             if not item[1]:  # drain=False: fail everything outstanding
                 self._fail_all(RequestTimeoutError("server stopped"))
@@ -339,7 +462,15 @@ class RequestQueue:
     def _loop(self) -> None:
         draining = False
         while True:
+            if self._kill_reason is not None:
+                raise _KilledError()
             now = time.perf_counter()
+            if now < self._wedge_until:
+                # chaos wedge: no absorption, no flush, no progress stamp —
+                # depth grows while last_progress goes stale
+                time.sleep(min(0.05, self._wedge_until - now))
+                continue
+            self.last_progress = now
             flush_at = self._next_flush_deadline()
             timeout = None if flush_at is None else max(flush_at - now, 0.0)
             if not draining:
@@ -365,7 +496,13 @@ class RequestQueue:
 
             now = time.perf_counter()
             for key in list(self._pending):
-                reqs = self._pending[key]
+                # a concurrent kill()'s _fail_all may clear pending under us:
+                # tolerate vanished keys instead of crashing the loop (the
+                # kill flag ends it at the next iteration)
+                reqs = self._pending.get(key)
+                if not reqs:
+                    self._pending.pop(key, None)
+                    continue
                 self._expire(key, reqs, now)
                 while len(reqs) >= self.engine.max_batch:
                     self._execute(key, reqs[: self.engine.max_batch])
@@ -375,7 +512,7 @@ class RequestQueue:
                     self._execute(key, reqs)
                     reqs.clear()
                 if not reqs:
-                    del self._pending[key]
+                    self._pending.pop(key, None)
             self.metrics.set_queue_depth(self.depth())
             if draining and not self._pending and self._ingress.empty():
                 return
@@ -402,6 +539,8 @@ class RequestQueue:
 
     def _execute(self, key, reqs: List[_Request]) -> None:
         kind, bucket, steps = key
+        if self._inject_latency_s > 0:
+            time.sleep(self._inject_latency_s)  # chaos: slow device
         t_start = time.perf_counter()
         try:
             outs = self._run_batch(key, reqs)
@@ -411,6 +550,7 @@ class RequestQueue:
             self._retry_individually(key, reqs)
             return
         now = time.perf_counter()
+        self.last_progress = now  # batch progress heartbeat for the supervisor
         lats = [(now - r.t_submit) * 1e3 for r in reqs]
         qms = [(t_start - r.t_submit) * 1e3 for r in reqs]
         self.metrics.batch_done(len(reqs), self.engine.max_batch, lats, qms)
@@ -457,8 +597,11 @@ class RequestQueue:
             r.future.set_result(out)
 
     def _fail_all(self, exc: BaseException) -> None:
-        for reqs in self._pending.values():
-            for r in reqs:
+        # list() copies: kill() calls this from a foreign thread while the
+        # dispatcher may still be mutating _pending; futures are first-wins
+        # so double resolution is harmless
+        for reqs in list(self._pending.values()):
+            for r in list(reqs):
                 r.future.set_exception(exc)
         self._pending.clear()
         while True:
@@ -466,5 +609,7 @@ class RequestQueue:
                 item = self._ingress.get_nowait()
             except _pyqueue.Empty:
                 return
+            if item is _KILL:
+                continue
             if not (isinstance(item, tuple) and item[0] is _STOP):
                 item.future.set_exception(exc)

@@ -1,52 +1,102 @@
-"""Multi-model routing — name -> (engine, queue, warmup state).
+"""Multi-model routing — name -> (replica set, warmup state).
 
 One gateway process fronts N independently-configured models (the
-``serve.models:`` config list): each :class:`ModelEntry` owns its own
-InferenceEngine (compile cache, ladder), RequestQueue (micro-batcher,
-admission), ServeMetrics, and warmup state, so one model's traffic or
-compile storm never perturbs another's rungs. The registry is the routing
-table the HTTP transport (``serve/transport.py``) resolves
+``serve.models:`` config list): each :class:`ModelEntry` owns a
+:class:`~distegnn_tpu.serve.replica.ReplicaSet` of ``serve.replicas``
+shared-nothing (engine, queue) pairs — every replica has its own
+InferenceEngine (compile cache) and RequestQueue (micro-batcher), all
+sharing one ServeMetrics — plus warmup state. One model's traffic, compile
+storm, or total replica loss never perturbs another model's entries: the
+registry reports per-model health and the transport sheds ONLY the broken
+model (typed 503 + Retry-After).
+
+The registry is the routing table the HTTP transport resolves
 ``/v1/models/<name>/...`` against, and the single lifecycle handle the
-gateway's SIGTERM drain walks (start all -> warm all -> stop(drain=True)
-all — queue.stop is idempotent, so a bench or atexit racing the drain is
-harmless).
+gateway's SIGTERM drain walks. ``stop(drain=True)`` drains every model
+CONCURRENTLY, each bounded by the grace budget, so one wedged queue can't
+eat every other model's drain window.
 
 Params come from ``model.checkpoint`` when set (verified restore via
 ``train/checkpoint.restore_params``); otherwise the entry initializes
 random params from the config seed — the synthetic-load/bench path.
+:meth:`ModelRegistry.swap` is the blue/green path for retrained models:
+checksummed restore, per-rung canary forward pass, one-replica-at-a-time
+atomic flips, auto-rollback on any failure — without dropping the queue.
 """
 
 from __future__ import annotations
 
 import copy
+import threading
+import time
 from typing import Dict, List, Optional, Sequence
 
 from distegnn_tpu import obs
 from distegnn_tpu.serve.buckets import Bucket, synthetic_graph
 from distegnn_tpu.serve.engine import InferenceEngine
 from distegnn_tpu.serve.queue import RequestQueue
+from distegnn_tpu.serve.replica import ReplicaSet
+
+
+class SwapError(RuntimeError):
+    """A blue/green swap failed (restore or canary stage). ``rolled_back``
+    is True when serving params are back to the pre-swap version — the
+    gateway reports it so operators know nothing is half-flipped."""
+
+    def __init__(self, msg: str, stage: str, rolled_back: bool):
+        super().__init__(msg)
+        self.stage = stage
+        self.rolled_back = bool(rolled_back)
+
+
+class SwapInProgressError(RuntimeError):
+    """A swap is already running for this model (one at a time)."""
 
 
 class ModelEntry:
-    """One served model: engine + queue + warmup state, owned by a name."""
+    """One served model: a replica set + warmup/swap state, owned by a name.
+
+    ``engine`` is the PRIMARY replica's engine — the stable handle for
+    feature widths, the session prep cache, and capability flags (engines
+    survive replica restarts; only queues are rebuilt). ``queue`` is the
+    replica set itself, which duck-types RequestQueue, so all pre-replica
+    callers (transport routes, benches, tests) work unchanged.
+    """
 
     def __init__(self, name: str, engine: InferenceEngine,
                  queue: RequestQueue, feat_nf: int, edge_attr_nf: int,
-                 config=None):
+                 config=None, extra_replicas: Sequence = (),
+                 supervisor_opts: Optional[dict] = None):
         self.name = name
         self.engine = engine
-        self.queue = queue
+        pairs = [(engine, queue)] + list(extra_replicas)
+        self.replicas = ReplicaSet(name, pairs,
+                                   supervisor_opts=supervisor_opts)
         self.feat_nf = int(feat_nf)
         self.edge_attr_nf = int(edge_attr_nf)
         self.config = config
         self.warmed: List[Bucket] = []
         self.state = "cold"            # cold -> ready | failed
         self.error: Optional[str] = None
+        self.checkpoint: Optional[str] = None
+        self.params_version = 0
+        self._swap_lock = threading.Lock()
+
+    @property
+    def queue(self) -> ReplicaSet:
+        return self.replicas
+
+    def start(self) -> None:
+        self.replicas.start()
+
+    def stop(self, drain: bool = True, join_timeout_s: float = 30.0) -> None:
+        self.replicas.stop(drain=drain, join_timeout_s=join_timeout_s)
 
     def warmup(self, nodes: Sequence[int]) -> None:
         """Pre-compile the rungs admitting synthetic graphs of the given
-        node counts; flips state to 'ready' (or 'failed', kept servable so
-        /v1/models can show WHY readiness is down)."""
+        node counts on EVERY replica engine; flips state to 'ready' (or
+        'failed', kept servable so /v1/models can show WHY readiness is
+        down)."""
         try:
             sizes = []
             for n in nodes:
@@ -54,7 +104,9 @@ class ModelEntry:
                                     edge_attr_nf=self.edge_attr_nf)
                 sizes.append((int(g["loc"].shape[0]),
                               int(g["edge_index"].shape[1])))
-            self.warmed = self.engine.warmup(sizes)
+            for r in self.replicas.replicas:
+                warmed = r.engine.warmup(sizes)
+            self.warmed = warmed
             self.state = "ready"
         except Exception as exc:
             self.state, self.error = "failed", repr(exc)
@@ -62,7 +114,76 @@ class ModelEntry:
                       error=repr(exc))
 
     def alive(self) -> bool:
-        return self.queue.alive()
+        return self.replicas.alive()
+
+    @property
+    def rollout_enabled(self) -> bool:
+        return self.engine.rollout_enabled
+
+    # ---- blue/green hot-swap ---------------------------------------------
+    def swap(self, checkpoint) -> dict:
+        """Swap serving params to ``checkpoint`` under load, blue/green:
+
+        1. checksummed params-only restore (``restore_params``) — corrupt
+           or shape-mismatched checkpoints fail HERE, params untouched;
+        2. per-replica canary: forward the CANDIDATE params through every
+           warmed rung's compiled executable on a synthetic graph
+           (NaN/shape check) before that replica flips;
+        3. atomic one-at-a-time flips (params are a runtime argument of the
+           shape-keyed executables — no recompile, the queue never drops);
+        4. any canary failure rolls every already-flipped replica back to
+           the old params and raises :class:`SwapError` (rolled_back=True).
+        """
+        from distegnn_tpu.train.checkpoint import restore_params
+
+        if not self._swap_lock.acquire(blocking=False):
+            raise SwapInProgressError(
+                f"a swap is already in progress for model '{self.name}'")
+        try:
+            obs.event("gateway/swap_begin", model=self.name,
+                      path=str(checkpoint))
+            old_params = self.engine.params
+            try:
+                new_params = restore_params(str(checkpoint), old_params)
+            except Exception as exc:
+                obs.event("gateway/swap_rollback", model=self.name,
+                          stage="restore", flipped=0, error=repr(exc)[:300])
+                raise SwapError(
+                    f"swap restore failed for '{self.name}': {exc}",
+                    stage="restore", rolled_back=True) from exc
+            rungs = list(self.warmed)
+            flipped: List = []
+            try:
+                for r in self.replicas.replicas:
+                    checked = r.engine.canary(new_params, rungs)
+                    obs.event("gateway/swap_canary", model=self.name,
+                              replica=r.idx, rungs=checked)
+                    r.engine.params = new_params
+                    flipped.append(r)
+                    obs.event("gateway/swap_flip", model=self.name,
+                              replica=r.idx)
+            except Exception as exc:
+                for r in flipped:
+                    r.engine.params = old_params
+                obs.event("gateway/swap_rollback", model=self.name,
+                          stage="canary", flipped=len(flipped),
+                          error=repr(exc)[:300])
+                raise SwapError(
+                    f"swap canary failed for '{self.name}': {exc}; rolled "
+                    f"back {len(flipped)} flipped replica(s)",
+                    stage="canary", rolled_back=True) from exc
+            self.checkpoint = str(checkpoint)
+            self.params_version += 1
+            obs.event("gateway/swap_done", model=self.name,
+                      path=str(checkpoint), version=self.params_version,
+                      replicas=len(self.replicas.replicas),
+                      rungs_canaried=len(rungs))
+            return {"model": self.name, "checkpoint": str(checkpoint),
+                    "version": self.params_version,
+                    "replicas": len(self.replicas.replicas),
+                    "rungs_canaried": len(rungs)}
+        finally:
+            self._swap_lock.release()
 
     def describe(self) -> dict:
         snap = self.engine.metrics.snapshot()
@@ -75,11 +196,15 @@ class ModelEntry:
             "max_batch": self.engine.max_batch,
             "ladder": {"max_nodes": self.engine.ladder.max_nodes,
                        "max_edges": self.engine.ladder.max_edges},
-            "queue_depth": self.queue.depth(),
+            "queue_depth": self.replicas.depth(),
             "requests_completed": snap["requests_completed"],
             # clients (scripts/traffic_gen.py) read this to know whether
             # rollout traffic is servable or would 501
-            "rollout": bool(getattr(self.engine, "_rollout_opts", None)),
+            "rollout": self.rollout_enabled,
+            "replicas": self.replicas.health(),
+            "replicas_available": self.replicas.available(),
+            "params_version": self.params_version,
+            "checkpoint": self.checkpoint,
         }
 
 
@@ -125,9 +250,13 @@ class ModelRegistry:
 
         from distegnn_tpu.models.registry import get_model
         from distegnn_tpu.serve import engine_from_config
+        from distegnn_tpu.serve.metrics import ServeMetrics
 
         model = get_model(cfg.model, dataset_name=cfg.data.dataset_name)
-        engine, queue = engine_from_config(cfg, model, params=None)
+        n_replicas = max(1, int(cfg.serve.get("replicas", 1) or 1))
+        metrics = ServeMetrics()  # shared by every replica of this model
+        engine, queue = engine_from_config(cfg, model, params=None,
+                                           metrics=metrics)
         feat_nf = int(cfg.model.node_feat_nf)
         edge_nf = int(cfg.model.edge_attr_nf)
         seed = int(cfg.get("seed", 0) or 0)
@@ -144,7 +273,21 @@ class ModelRegistry:
             params = restore_params(ckpt, params)
             obs.event("gateway/params_restored", model=name, path=str(ckpt))
         engine.params = params
-        return ModelEntry(name, engine, queue, feat_nf, edge_nf, config=cfg)
+        extra = []
+        for _ in range(n_replicas - 1):
+            eng_i, q_i = engine_from_config(cfg, model, params=params,
+                                            metrics=metrics)
+            # the prep-plan cache is engine-agnostic (pure layout plans):
+            # share it so a failed-over session keeps its prep hit rate
+            eng_i.prep_cache = engine.prep_cache
+            extra.append((eng_i, q_i))
+        entry = ModelEntry(name, engine, queue, feat_nf, edge_nf, config=cfg,
+                           extra_replicas=extra,
+                           supervisor_opts=dict(cfg.serve.get("supervisor")
+                                                or {}))
+        if ckpt:
+            entry.checkpoint = str(ckpt)
+        return entry
 
     @classmethod
     def single(cls, name: str, engine: InferenceEngine, queue: RequestQueue,
@@ -167,26 +310,69 @@ class ModelRegistry:
     def __len__(self) -> int:
         return len(self._entries)
 
+    # ---- blue/green hot-swap ---------------------------------------------
+    def swap(self, name: str, checkpoint) -> dict:
+        """Blue/green swap one model's params under load (KeyError -> the
+        transport's 404; see :meth:`ModelEntry.swap`)."""
+        return self._entries[name].swap(checkpoint)
+
     # ---- lifecycle -------------------------------------------------------
     def start(self) -> "ModelRegistry":
         for _, e in self.items():
-            e.queue.start()
+            e.start()
         return self
 
     def warmup(self, nodes: Sequence[int]) -> None:
         for _, e in self.items():
             e.warmup(nodes)
 
-    def stop(self, drain: bool = True) -> None:
-        """Stop every queue (idempotent; safe from a SIGTERM handler thread
-        racing other shutdown paths)."""
-        for _, e in self.items():
-            e.queue.stop(drain=drain)
+    def stop(self, drain: bool = True,
+             grace_s: Optional[float] = None) -> None:
+        """Stop every model CONCURRENTLY (idempotent; safe from a SIGTERM
+        handler thread racing other shutdown paths). Each model drains in
+        parallel bounded by ``grace_s`` (default 30 s), so one wedged
+        queue can't consume every other model's drain window."""
+        budget = 30.0 if grace_s is None else max(float(grace_s), 0.1)
+        entries = self.items()
+        if len(entries) == 1:
+            entries[0][1].stop(drain=drain, join_timeout_s=budget)
+            return
+        threads = []
+        for _, e in entries:
+            t = threading.Thread(target=e.stop, name=f"drain-{e.name}",
+                                 kwargs=dict(drain=drain,
+                                             join_timeout_s=budget),
+                                 daemon=True)
+            t.start()
+            threads.append(t)
+        deadline = time.monotonic() + budget + 5.0
+        for t in threads:
+            t.join(timeout=max(deadline - time.monotonic(), 0.1))
 
+    # ---- health -----------------------------------------------------------
     def ready(self) -> bool:
         """All models warmed and their dispatcher threads alive."""
         return all(e.state == "ready" and e.alive()
                    for e in self._entries.values())
+
+    def any_ready(self) -> bool:
+        """At least one model is servable — the gateway keeps routing in
+        degraded mode instead of flipping the whole fleet to 503."""
+        return any(e.state == "ready" and e.alive()
+                   for e in self._entries.values())
+
+    def health(self) -> Dict[str, dict]:
+        """Per-model readiness detail for /readyz's degraded reporting."""
+        out: Dict[str, dict] = {}
+        for name, e in self.items():
+            out[name] = {
+                "state": e.state,
+                "ready": e.state == "ready" and e.alive(),
+                "error": e.error,
+                "replicas_available": e.replicas.available(),
+                "replicas_total": len(e.replicas.replicas),
+            }
+        return out
 
     def describe(self) -> dict:
         return {"models": [e.describe() for _, e in self.items()]}

@@ -1,0 +1,313 @@
+"""Shared-nothing engine replicas behind one admission front.
+
+A :class:`ReplicaSet` owns N (engine, RequestQueue) pairs for one model and
+duck-types the single RequestQueue the transport used to hold: ``submit`` /
+``submit_rollout`` / ``depth`` / ``alive`` / ``start`` / ``stop`` keep their
+signatures, so every existing consumer (gateway routes, serve_bench,
+``ModelRegistry.single``-based tests) works unchanged with ``replicas: 1``.
+
+What changes with N > 1:
+
+  - admission picks a HEALTHY replica round-robin; the caller gets an OUTER
+    :class:`~distegnn_tpu.serve.queue.ServeFuture` wired to the replica's
+    inner future via ``add_done_callback``
+  - if the chosen replica's dispatcher dies with the request in flight
+    (inner future resolves with :class:`DispatcherCrashError`), the request
+    FAILS OVER to a survivor — at most once per replica, tracked in the
+    record's ``tried`` set, so a poison batch that kills whoever runs it
+    can't ping-pong forever
+  - when no replica is available AND the set is supervised, admission raises
+    :class:`ModelUnavailableError` carrying a ``retry_after_s`` hint derived
+    from the earliest scheduled restart — the gateway maps it to a typed 503
+    + ``Retry-After`` for THIS model only; other models keep serving
+  - an unsupervised set (never ``start()``-ed, e.g. tests poking the raw
+    queue) passes through to replica 0 so the queue's own admission errors
+    (not-started RuntimeError, QueueFullError) surface exactly as before
+
+Failover is AT-MOST-ONCE per delivery: in-flight records are claimed either
+by the inner future's done-callback or by the supervisor's drain — never
+both — via ``Replica.untrack``'s compare-and-pop, and the outer future's
+first-wins resolution drops any late result from an abandoned replica.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, List, Optional, Set
+
+from distegnn_tpu import obs
+from distegnn_tpu.serve.queue import (DispatcherCrashError, RequestQueue,
+                                      ServeFuture)
+
+
+class ModelUnavailableError(RuntimeError):
+    """Every replica of one model is down (crashed/broken/restarting).
+
+    ``retry_after_s`` is the serving hint for the gateway's ``Retry-After``
+    header: time until the earliest scheduled replica restart, floored so
+    clients never busy-spin.
+    """
+
+    def __init__(self, model: str, retry_after_s: float = 1.0):
+        super().__init__(
+            f"model '{model}' has no live replicas (all crashed, wedged, or "
+            f"in breaker cooldown); retry after {retry_after_s:.1f} s")
+        self.model = model
+        self.retry_after_s = float(retry_after_s)
+
+
+class _Tracked:
+    """One admitted request: the outer future handed to the caller plus
+    everything needed to re-dispatch it to a survivor."""
+
+    __slots__ = ("kind", "payload", "bucket", "request_id", "outer", "tried")
+
+    def __init__(self, kind: str, payload: dict, bucket, request_id,
+                 outer: ServeFuture):
+        self.kind = kind            # "predict" | "rollout"
+        self.payload = payload
+        self.bucket = bucket        # predict-only override (may be None)
+        self.request_id = request_id
+        self.outer = outer
+        self.tried: Set[int] = set()  # replica indices that saw this request
+
+
+class Replica:
+    """One engine + its current dispatcher queue, plus supervision state.
+
+    The ENGINE is stable across restarts (its per-rung compile cache is the
+    expensive part); only the RequestQueue — the crashed thread and its
+    poisoned pending state — is rebuilt.
+
+    States: ``init`` (built, not started) → ``running`` → ``backoff``
+    (crashed/wedged, restart scheduled) → ``broken`` (circuit breaker open,
+    long cooldown) → ``running`` again, or → ``stopped`` (clean shutdown).
+    """
+
+    def __init__(self, idx: int, engine, queue: RequestQueue):
+        self.idx = idx
+        self.engine = engine
+        self.queue = queue
+        self.state = "init"
+        self.failures = 0        # consecutive supervised failures (breaker)
+        self.restarts = 0        # lifetime supervised restarts
+        self.started_at = 0.0
+        self.next_restart_at = 0.0
+        self.last_reason: Optional[str] = None
+        self._inflight: Dict[int, _Tracked] = {}
+        self._lock = threading.Lock()
+
+    def healthy(self) -> bool:
+        return self.state == "running" and self.queue.alive()
+
+    # ---- in-flight tracking (at-most-once claim protocol) ----------------
+    def track(self, rec: _Tracked) -> None:
+        with self._lock:
+            self._inflight[id(rec)] = rec
+
+    def untrack(self, rec: _Tracked) -> bool:
+        """Claim one record; True for exactly one of the competing claimers
+        (inner-future callback vs supervisor drain)."""
+        with self._lock:
+            return self._inflight.pop(id(rec), None) is not None
+
+    def drain_inflight(self) -> List[_Tracked]:
+        with self._lock:
+            recs = list(self._inflight.values())
+            self._inflight.clear()
+        return recs
+
+    def inflight_count(self) -> int:
+        with self._lock:
+            return len(self._inflight)
+
+    def fresh_queue(self) -> RequestQueue:
+        """Replacement RequestQueue cloned from the dead one's knobs; the
+        warmed engine (and its compile cache) is reused as-is."""
+        old = self.queue
+        self.queue = RequestQueue(
+            self.engine,
+            batch_deadline_ms=old.batch_deadline * 1e3,
+            queue_capacity=old._ingress.maxsize,
+            request_timeout_ms=old.request_timeout * 1e3,
+            result_margin_s=old.result_margin,
+            metrics=old.metrics)
+        return self.queue
+
+
+class ReplicaSet:
+    """N shared-nothing replicas of one model behind one admission front.
+
+    Duck-types RequestQueue for the transport/registry (submit,
+    submit_rollout, depth, alive, start, stop), adds the failover and
+    health surface, and owns the :class:`ReplicaSupervisor`.
+    """
+
+    def __init__(self, model: str, pairs, *, supervisor_opts: Optional[dict] = None):
+        if not pairs:
+            raise ValueError("ReplicaSet needs at least one (engine, queue)")
+        self.model = model
+        self.replicas = [Replica(i, eng, q) for i, (eng, q) in enumerate(pairs)]
+        self.metrics = self.replicas[0].queue.metrics
+        self.request_timeout = self.replicas[0].queue.request_timeout
+        self.result_margin = self.replicas[0].queue.result_margin
+        self._rr = 0
+        self._lock = threading.Lock()
+        self._supervised = False
+        from distegnn_tpu.serve.supervisor import ReplicaSupervisor
+        self.supervisor = ReplicaSupervisor(self, **(supervisor_opts or {}))
+
+    # ---- RequestQueue-compatible surface ---------------------------------
+    @property
+    def engine(self):
+        """Primary replica's engine — the registry's width/session-cache/
+        capability handle (stable across restarts)."""
+        return self.replicas[0].engine
+
+    @property
+    def ladder(self):
+        return self.replicas[0].engine.ladder
+
+    def start(self) -> "ReplicaSet":
+        now = time.perf_counter()
+        for r in self.replicas:
+            r.queue.start()
+            r.state = "running"
+            r.started_at = now
+        self._supervised = True
+        self.supervisor.start()
+        return self
+
+    def stop(self, drain: bool = True, join_timeout_s: float = 30.0) -> None:
+        self._supervised = False
+        self.supervisor.stop()
+        for r in self.replicas:
+            r.queue.stop(drain=drain, join_timeout_s=join_timeout_s)
+            r.state = "stopped"
+
+    def alive(self) -> bool:
+        return any(r.queue.alive() for r in self.replicas)
+
+    def depth(self) -> int:
+        return sum(r.queue.depth() for r in self.replicas)
+
+    def submit(self, graph: dict, bucket=None,
+               request_id: Optional[str] = None) -> ServeFuture:
+        return self._admit("predict", graph, bucket, request_id)
+
+    def submit_rollout(self, scene: dict,
+                       request_id: Optional[str] = None) -> ServeFuture:
+        return self._admit("rollout", scene, None, request_id)
+
+    # ---- dispatch / failover ---------------------------------------------
+    def _admit(self, kind: str, payload: dict, bucket, request_id) -> ServeFuture:
+        now = time.perf_counter()
+        outer = ServeFuture(
+            hard_deadline=now + self.request_timeout + self.result_margin)
+        rec = _Tracked(kind, payload, bucket, request_id, outer)
+        self._dispatch(rec, admission=True)
+        return outer
+
+    def _choose(self, exclude: Set[int]) -> Optional[Replica]:
+        with self._lock:
+            cands = [r for r in self.replicas
+                     if r.idx not in exclude and r.healthy()]
+            if not cands:
+                return None
+            self._rr += 1
+            return cands[self._rr % len(cands)]
+
+    def _dispatch(self, rec: _Tracked, admission: bool) -> None:
+        replica = self._choose(rec.tried)
+        if replica is None:
+            if not self._supervised and not rec.tried:
+                # legacy pass-through: an unstarted/unsupervised set surfaces
+                # replica 0's own admission errors (RuntimeError not-started,
+                # QueueFullError) exactly as the single-queue gateway did
+                replica = self.replicas[0]
+            else:
+                exc = ModelUnavailableError(self.model,
+                                            retry_after_s=self.retry_after_s())
+                if admission:
+                    raise exc
+                rec.outer.set_exception(exc)
+                return
+        rec.tried.add(replica.idx)
+        try:
+            if rec.kind == "rollout":
+                inner = replica.queue.submit_rollout(
+                    rec.payload, request_id=rec.request_id)
+            else:
+                inner = replica.queue.submit(
+                    rec.payload, bucket=rec.bucket, request_id=rec.request_id)
+        except Exception:
+            if admission:
+                raise  # typed 4xx/5xx mapping happens at the gateway
+            # survivor couldn't admit (full / just died): try the next one;
+            # recursion is bounded by the growing tried set
+            self._dispatch(rec, admission=False)
+            return
+        replica.track(rec)
+        inner.add_done_callback(
+            lambda fut, rec=rec, rep=replica: self._on_inner_done(rec, rep, fut))
+
+    def _on_inner_done(self, rec: _Tracked, replica: Replica,
+                       inner: ServeFuture) -> None:
+        if not replica.untrack(rec):
+            return  # supervisor already claimed it (drained for failover)
+        exc = inner.exception()
+        if isinstance(exc, DispatcherCrashError):
+            self._fail_over(rec, replica, reason=str(exc))
+            return
+        rec.outer.meta.update(inner.meta)
+        rec.outer.meta["replica"] = replica.idx
+        if exc is not None:
+            rec.outer.set_exception(exc)
+        else:
+            rec.outer.set_result(inner._result)
+
+    def _fail_over(self, rec: _Tracked, dead: Replica, reason: str) -> None:
+        self.metrics.failed_over()
+        obs.event("gateway/replica_failover", model=self.model,
+                  replica=dead.idx, request_id=rec.request_id,
+                  tried=sorted(rec.tried), reason=reason[:160])
+        self._dispatch(rec, admission=False)
+
+    def fail_over_replica(self, replica: Replica, reason: str) -> int:
+        """Supervisor entry point: claim and re-dispatch everything in
+        flight on a dead/wedged replica. Returns how many moved."""
+        recs = replica.drain_inflight()
+        for rec in recs:
+            self._fail_over(rec, replica, reason=reason)
+        return len(recs)
+
+    # ---- health / hints ---------------------------------------------------
+    def available(self) -> int:
+        return sum(1 for r in self.replicas if r.healthy())
+
+    def health(self) -> List[dict]:
+        return [{"replica": r.idx, "state": r.state,
+                 "alive": r.queue.alive(), "failures": r.failures,
+                 "restarts": r.restarts, "inflight": r.inflight_count(),
+                 "depth": r.queue.depth(), "last_reason": r.last_reason}
+                for r in self.replicas]
+
+    def retry_after_s(self) -> float:
+        """Hint for 503 Retry-After: time to the earliest scheduled replica
+        restart (floored at 0.1 s so clients never busy-spin)."""
+        now = time.perf_counter()
+        waits = [r.next_restart_at - now for r in self.replicas
+                 if r.state in ("backoff", "broken")]
+        if not waits:
+            return 1.0
+        return round(max(min(waits), 0.1), 3)
+
+    def queue_retry_after_s(self) -> float:
+        """Hint for 429 Retry-After: roughly how long the current backlog
+        takes to drain (one batch deadline per max_batch queued requests),
+        clamped to [0.1, 5] s."""
+        per_batch = max(self.replicas[0].queue.batch_deadline, 0.01)
+        max_batch = max(int(getattr(self.engine, "max_batch", 1)), 1)
+        est = per_batch * (1.0 + self.depth() / max_batch)
+        return round(min(max(est, 0.1), 5.0), 3)

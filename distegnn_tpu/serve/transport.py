@@ -58,7 +58,9 @@ from distegnn_tpu.obs.metrics import MetricsRegistry, _prom_name
 from distegnn_tpu.serve.buckets import BucketOverflowError
 from distegnn_tpu.serve.engine import RolloutOverflowError
 from distegnn_tpu.serve.queue import QueueFullError, RequestTimeoutError
-from distegnn_tpu.serve.registry import ModelRegistry
+from distegnn_tpu.serve.registry import (ModelRegistry, SwapError,
+                                         SwapInProgressError)
+from distegnn_tpu.serve.replica import ModelUnavailableError
 
 
 class PayloadError(ValueError):
@@ -237,6 +239,7 @@ _GATEWAY_COUNTERS = (
     "requests_total", "predict_ok", "rollout_ok", "shed_inflight",
     "shed_queue_full", "timeouts", "bad_requests", "unknown_model",
     "overflow_rejected", "draining_rejected", "rollout_overflow",
+    "model_unavailable", "swap_ok", "swap_failed",
     "errors",
 )
 
@@ -326,7 +329,18 @@ class Gateway:
         self._accepting = False
         self._ready_gauge.set(0.0)
         obs.event("gateway/drain_begin", inflight=self._inflight)
-        self.registry.stop(drain=True)   # every admitted future resolves
+        # every admitted future resolves; models drain CONCURRENTLY, each
+        # bounded by the grace budget (registry.stop). Signature-aware so a
+        # wrapped/monkeypatched stop(drain=...) still works.
+        stop_kwargs = {"drain": True}
+        try:
+            import inspect
+
+            if "grace_s" in inspect.signature(self.registry.stop).parameters:
+                stop_kwargs["grace_s"] = self.drain_grace_s
+        except (TypeError, ValueError):
+            pass
+        self.registry.stop(**stop_kwargs)
         deadline = time.monotonic() + self.drain_grace_s
         while time.monotonic() < deadline:
             with self._inflight_lock:
@@ -349,6 +363,8 @@ class Gateway:
                 return "predict"
             if path.endswith("/rollout"):
                 return "rollout"
+            if path.endswith("/swap"):
+                return "swap"
         return {"/v1/models": "models", "/metrics": "metrics",
                 "/healthz": "healthz", "/readyz": "readyz"}.get(path,
                                                                 "unknown")
@@ -389,19 +405,42 @@ class Gateway:
                 return self._send_json(h, 405, {"error": "POST only",
                                                 "type": "MethodNotAllowed"})
             return self._infer(h, path, route)
+        if route == "swap":
+            if method != "POST":
+                return self._send_json(h, 405, {"error": "POST only",
+                                                "type": "MethodNotAllowed"})
+            if not self._accepting:
+                self._c["draining_rejected"].add(1)
+                return self._send_json(h, 503, {
+                    "error": "gateway draining", "type": "Draining"},
+                    retry_after=self.drain_grace_s)
+            return self._swap(h, path)
         if method != "GET":
             return self._send_json(h, 405, {"error": "GET only",
                                             "type": "MethodNotAllowed"})
         if route == "healthz":
             return self._send_json(h, 200, {"status": "ok"})
         if route == "readyz":
-            self._ready_gauge.set(1.0 if self.ready() else 0.0)
-            if self.ready():
-                return self._send_json(h, 200, {"ready": True})
-            reason = ("draining" if not self._accepting else
-                      "models not warmed or dispatcher down")
-            return self._send_json(h, 503, {"ready": False,
-                                            "reason": reason})
+            fully_ready = self.ready()
+            self._ready_gauge.set(1.0 if fully_ready else 0.0)
+            if not self._accepting:
+                return self._send_json(h, 503, {
+                    "ready": False, "reason": "draining"},
+                    retry_after=self.drain_grace_s)
+            health = self.registry.health()
+            if fully_ready:
+                return self._send_json(h, 200, {"ready": True,
+                                                "models": health})
+            if self.registry.any_ready():
+                # degraded: the broken model 503s on its own routes while
+                # every ready model keeps serving — report which is which
+                return self._send_json(h, 200, {"ready": True,
+                                                "degraded": True,
+                                                "models": health})
+            return self._send_json(h, 503, {
+                "ready": False,
+                "reason": "models not warmed or dispatcher down",
+                "models": health}, retry_after=1.0)
         if route == "metrics":
             return self._send_text(h, 200, self.render_metrics(),
                                    content_type="text/plain; version=0.0.4")
@@ -416,12 +455,14 @@ class Gateway:
             self._c["shed_inflight"].add(1)
             return self._send_json(h, 429, {
                 "error": f"gateway at max_inflight={self.max_inflight}; "
-                         "retry with backoff", "type": "Overloaded"})
+                         "retry with backoff", "type": "Overloaded"},
+                retry_after=0.5)
         try:
             if not self._accepting:
                 self._c["draining_rejected"].add(1)
                 return self._send_json(h, 503, {
-                    "error": "gateway draining", "type": "Draining"})
+                    "error": "gateway draining", "type": "Draining"},
+                    retry_after=self.drain_grace_s)
             try:
                 entry = self.registry.get(name)
             except KeyError:
@@ -429,29 +470,54 @@ class Gateway:
                 return self._send_json(h, 404, {
                     "error": f"unknown model {name!r}; "
                              f"see GET /v1/models", "type": "UnknownModel"})
+            if entry.state == "failed":
+                # per-model shed: THIS model failed warmup; every other
+                # model keeps serving (see /readyz degraded detail)
+                self._c["model_unavailable"].add(1)
+                return self._send_json(h, 503, {
+                    "error": f"model {name!r} failed warmup: {entry.error}",
+                    "type": "ModelFailed"}, retry_after=30.0)
             if route == "rollout":
                 return self._rollout_admitted(h, name, entry)
             return self._predict_admitted(h, name, entry)
         finally:
             self._release()
 
-    def _submit_guarded(self, h, submit_fn):
+    def _submit_guarded(self, h, submit_fn, entry=None):
         """Run one queue submit, mapping the admission errors to their HTTP
         statuses. Returns (future, None) or (None, status)."""
         try:
             return submit_fn(), None
         except QueueFullError as exc:
             self._c["shed_queue_full"].add(1)
-            return None, self._send_json(h, 429, {"error": str(exc),
-                                                  "type": "QueueFull"})
+            return None, self._send_json(
+                h, 429, {"error": str(exc), "type": "QueueFull"},
+                retry_after=self._queue_retry_after(entry))
         except BucketOverflowError as exc:
             self._c["overflow_rejected"].add(1)
             return None, self._send_json(h, 413, {"error": str(exc),
                                                   "type": "BucketOverflow"})
+        except ModelUnavailableError as exc:
+            # all replicas of THIS model are down; others keep serving
+            self._c["model_unavailable"].add(1)
+            return None, self._send_json(
+                h, 503, {"error": str(exc), "type": "ModelUnavailable",
+                         "model": exc.model},
+                retry_after=exc.retry_after_s)
         except RuntimeError as exc:       # queue stopped under our feet
             self._c["draining_rejected"].add(1)
             return None, self._send_json(h, 503, {"error": str(exc),
-                                                  "type": "Draining"})
+                                                  "type": "Draining"},
+                                         retry_after=1.0)
+
+    @staticmethod
+    def _queue_retry_after(entry) -> Optional[float]:
+        """429 Retry-After hint from the model's backlog (replica sets
+        estimate drain time from queue depth; plain queues get a floor)."""
+        if entry is None:
+            return 1.0
+        hint = getattr(entry.queue, "queue_retry_after_s", None)
+        return hint() if callable(hint) else 1.0
 
     def _predict_admitted(self, h, name: str, entry) -> int:
         payload = self._read_json(h)
@@ -473,7 +539,7 @@ class Gateway:
                        "prep_ms": round((time.perf_counter() - t0) * 1e3, 3)}
         fut, status = self._submit_guarded(
             h, lambda: entry.queue.submit(graph, bucket=bucket,
-                                          request_id=rid))
+                                          request_id=rid), entry)
         if fut is None:
             return status
         try:
@@ -482,6 +548,13 @@ class Gateway:
             self._c["timeouts"].add(1)
             return self._send_json(h, 504, {"error": str(exc),
                                             "type": "RequestTimeout"})
+        except ModelUnavailableError as exc:
+            # admitted, then every replica (and failover) died under it
+            self._c["model_unavailable"].add(1)
+            return self._send_json(
+                h, 503, {"error": str(exc), "type": "ModelUnavailable",
+                         "model": exc.model},
+                retry_after=exc.retry_after_s)
         if perm is not None:
             # the session plan served the model a Morton-relabeled graph;
             # answer in the client's original node order
@@ -506,7 +579,7 @@ class Gateway:
         return self._send_json(h, 200, body)
 
     def _rollout_admitted(self, h, name: str, entry) -> int:
-        if not getattr(entry.engine, "_rollout_opts", None):
+        if not entry.engine.rollout_enabled:
             return self._send_json(h, 501, {
                 "error": f"model {name!r} was built without serve.rollout; "
                          "set serve.rollout in its config to enable the "
@@ -519,7 +592,8 @@ class Gateway:
         t0 = time.perf_counter()
         rid = getattr(h, "request_id", None)
         fut, status = self._submit_guarded(
-            h, lambda: entry.queue.submit_rollout(scene, request_id=rid))
+            h, lambda: entry.queue.submit_rollout(scene, request_id=rid),
+            entry)
         if fut is None:
             return status
         try:
@@ -528,6 +602,12 @@ class Gateway:
             self._c["timeouts"].add(1)
             return self._send_json(h, 504, {"error": str(exc),
                                             "type": "RequestTimeout"})
+        except ModelUnavailableError as exc:
+            self._c["model_unavailable"].add(1)
+            return self._send_json(
+                h, 503, {"error": str(exc), "type": "ModelUnavailable",
+                         "model": exc.model},
+                retry_after=exc.retry_after_s)
         except RolloutOverflowError as exc:
             # a well-formed request whose scene outgrew the model's static
             # neighbor capacity — the client's to fix, not a server error
@@ -549,6 +629,41 @@ class Gateway:
             "total_ms": round((time.perf_counter() - t0) * 1e3, 3),
         })
 
+    # ---- blue/green hot-swap --------------------------------------------
+    def _swap(self, h, path: str) -> int:
+        """POST /v1/models/<name>/swap {"checkpoint": <path>} — blue/green
+        params swap under load (registry.swap: checksummed restore, per-rung
+        canary, one-at-a-time replica flips, auto-rollback)."""
+        name = path[len("/v1/models/"):-len("/swap")]
+        try:
+            entry = self.registry.get(name)
+        except KeyError:
+            self._c["unknown_model"].add(1)
+            return self._send_json(h, 404, {
+                "error": f"unknown model {name!r}; see GET /v1/models",
+                "type": "UnknownModel"})
+        payload = self._read_json(h)
+        ckpt = payload.get("checkpoint")
+        if not ckpt or not isinstance(ckpt, str):
+            raise PayloadError("'checkpoint' (a path string) is required")
+        try:
+            info = entry.swap(ckpt)
+        except SwapInProgressError as exc:
+            self._c["swap_failed"].add(1)
+            return self._send_json(h, 409, {"error": str(exc),
+                                            "type": "SwapInProgress"},
+                                   retry_after=1.0)
+        except SwapError as exc:
+            # the swap REJECTED the checkpoint and rolled back — serving
+            # params are unchanged; the client's checkpoint is the problem
+            self._c["swap_failed"].add(1)
+            return self._send_json(h, 422, {
+                "error": str(exc), "type": "SwapFailed",
+                "stage": exc.stage, "rolled_back": exc.rolled_back})
+        self._c["swap_ok"].add(1)
+        info["request_id"] = getattr(h, "request_id", None)
+        return self._send_json(h, 200, info)
+
     # ---- metrics ---------------------------------------------------------
     def render_metrics(self) -> str:
         """Prometheus text: the gateway/process-wide registry, then each
@@ -558,6 +673,14 @@ class Gateway:
             self._inflight_gauge.set(self._inflight)
         self._ready_gauge.set(1.0 if self.ready() else 0.0)
         self.slo_monitor.export(self._reg, self.registry)
+        # per-replica health gauges: 1 = running with a live dispatcher
+        for name, entry in self.registry.items():
+            for rh in entry.replicas.health():
+                up = 1.0 if (rh["state"] == "running" and rh["alive"]) else 0.0
+                self._reg.gauge(
+                    f"gateway/replica_{name}_{rh['replica']}_up").set(up)
+            self._reg.gauge(f"gateway/replicas_{name}_available").set(
+                entry.replicas.available())
         parts = [self._reg.render_prometheus(prefix="distegnn")]
         for name, entry in self.registry.items():
             parts.append(entry.engine.metrics.registry.render_prometheus(
@@ -592,7 +715,8 @@ class Gateway:
 
     @staticmethod
     def _send_text(h, status: int, text: str,
-                   content_type: str = "text/plain") -> int:
+                   content_type: str = "text/plain",
+                   retry_after: Optional[float] = None) -> int:
         body = text.encode("utf-8")
         h.send_response(status)
         h.send_header("Content-Type", content_type)
@@ -600,14 +724,21 @@ class Gateway:
         rid = getattr(h, "request_id", None)
         if rid is not None:
             h.send_header("X-Request-Id", rid)
+        if retry_after is not None:
+            # decimal seconds (spec allows integers; our client and most
+            # libraries parse floats) — derived from queue depth / breaker
+            # cooldown so clients back off instead of hammering a shed
+            h.send_header("Retry-After", str(round(max(retry_after, 0.1), 3)))
         h.end_headers()
         h.wfile.write(body)
         return status
 
     @classmethod
-    def _send_json(cls, h, status: int, obj) -> int:
+    def _send_json(cls, h, status: int, obj,
+                   retry_after: Optional[float] = None) -> int:
         return cls._send_text(h, status, json.dumps(obj),
-                              content_type="application/json")
+                              content_type="application/json",
+                              retry_after=retry_after)
 
 
 def _make_handler(gateway: Gateway):

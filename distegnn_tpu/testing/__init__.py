@@ -1,8 +1,10 @@
 """Deterministic fault injection for the resilience layer (docs/ROBUSTNESS.md).
 
 Everything here is test machinery: injectors that corrupt checkpoints, poison
-batches, and fail file opens on demand (``faults``), plus a tiny subprocess
-training entry point (``tiny_run``) the kill-and-resume tests drive.
+batches, and fail file opens on demand (``faults``), serving chaos injectors
+that kill/wedge replicas and sabotage hot-swaps in a live gateway
+(``serve_faults``), plus a tiny subprocess training entry point
+(``tiny_run``) the kill-and-resume tests drive.
 """
 
 from distegnn_tpu.testing.faults import (
@@ -12,6 +14,12 @@ from distegnn_tpu.testing.faults import (
     poison_nan_batches,
     simulate_killed_save,
 )
+from distegnn_tpu.testing.serve_faults import (
+    corrupt_swap_checkpoint,
+    inject_execute_latency,
+    kill_replica,
+    wedge_replica,
+)
 
 __all__ = [
     "corrupt_checkpoint",
@@ -19,4 +27,8 @@ __all__ = [
     "poison_nan_batches",
     "flaky_open",
     "inject_at_call",
+    "kill_replica",
+    "wedge_replica",
+    "inject_execute_latency",
+    "corrupt_swap_checkpoint",
 ]

@@ -1,0 +1,78 @@
+"""Serving-layer fault injectors (docs/ROBUSTNESS.md, serving chaos harness).
+
+Each injector reproduces ONE serving failure mode at an exact point in a
+LIVE in-process gateway (they reach through the ModelRegistry into the
+replica pool), so tests/test_replica.py and ``scripts/traffic_gen.py
+--chaos`` can prove the recovery path under real traffic:
+
+  - :func:`kill_replica` — the dispatcher thread dies abruptly (a crashed
+    device runtime / OOM-killed worker). The supervisor must detect the
+    dead queue, fail in-flight work over to survivors, and restart it
+    behind backoff.
+  - :func:`wedge_replica` — the dispatcher stays alive but stops making
+    batch progress for ``duration_s`` (a stuck collective / hung device
+    call). Wedge detection must claim the in-flight work, abandon the
+    queue, and restart.
+  - :func:`inject_execute_latency` — every batch execute takes an extra
+    ``seconds`` (slow device / thermal throttle), inflating queue delay
+    without breaking anything: the SLO harness sees honest degradation.
+  - :func:`corrupt_swap_checkpoint` — damage a checkpoint file that a
+    blue/green swap is about to restore from (torn write / bit-rot); the
+    swap must fail at the restore stage and roll back, never flipping a
+    replica onto garbage params.
+
+All injectors are process-local: they need the registry object, not a URL
+(``traffic_gen --chaos`` therefore refuses to run against ``--url``).
+"""
+
+from __future__ import annotations
+
+from distegnn_tpu.testing.faults import corrupt_checkpoint
+
+
+def _replica(registry, model: str, replica: int):
+    entry = registry.get(model)
+    rset = entry.replicas
+    if not 0 <= int(replica) < len(rset.replicas):
+        raise IndexError(
+            f"model {model!r} has {len(rset.replicas)} replica(s); "
+            f"no replica {replica}")
+    return rset.replicas[int(replica)]
+
+
+def kill_replica(registry, model: str, replica: int = 0) -> None:
+    """Abruptly kill one replica's dispatcher: its queue fails all queued
+    futures typed and the thread exits at its next wake-up. With
+    ``serve.replicas >= 2`` the supervisor fails the in-flight work over to
+    survivors; single-replica models shed with 503 until the restart."""
+    _replica(registry, model, replica).queue.kill(
+        reason=f"chaos: killed replica {replica}")
+
+
+def wedge_replica(registry, model: str, duration_s: float,
+                  replica: int = 0) -> None:
+    """Freeze one replica's dispatcher for ``duration_s`` seconds without
+    killing it — no batch progress, ``last_progress`` goes stale. Pick a
+    duration beyond ``serve.supervisor.wedge_timeout_s`` to trigger wedge
+    detection, or below it to exercise pure queueing delay."""
+    _replica(registry, model, replica).queue.wedge(float(duration_s))
+
+
+def inject_execute_latency(registry, model: str, seconds: float,
+                           replica: int | None = None) -> None:
+    """Add ``seconds`` of latency to every batch execute on one replica
+    (or all replicas of the model when ``replica`` is None). Pass 0 to
+    clear the injection."""
+    entry = registry.get(model)
+    targets = (entry.replicas.replicas if replica is None
+               else [_replica(registry, model, replica)])
+    for r in targets:
+        r.queue.inject_latency(float(seconds))
+
+
+def corrupt_swap_checkpoint(path: str, mode: str = "garbage") -> None:
+    """Damage the checkpoint a blue/green swap is about to load (modes as
+    :func:`distegnn_tpu.testing.faults.corrupt_checkpoint`): the swap's
+    checksummed restore must fail and the swap must report
+    ``stage="restore", rolled_back=True`` without touching live params."""
+    corrupt_checkpoint(path, mode=mode)

@@ -417,6 +417,41 @@ EOF
 export -f gateway_traffic_and_check  # run_bounded's bash -c needs it
 run_bounded gateway_traffic gateway_traffic_and_check
 
+# 3a'. gateway chaos drill: the same replay against a 2-replica in-process
+#      gateway with a scheduled dispatcher kill + wedge mid-run
+#      (docs/ROBUSTNESS.md "Serving fault tolerance"). The done-marker keys
+#      on zero lost accepted requests and full completion through the
+#      faults; the SLO gate re-derives the verdict from the archived event
+#      stream alone, same as 3a. Bounded by construction (fixed plan +
+#      per-request timeout + Retry-After-capped retries).
+chaos_gateway_and_check() {
+  local stamp obsdir
+  stamp=$(date -u +%Y%m%dT%H%M%S)
+  obsdir=logs/traffic_gen/hw_chaos_$stamp
+  python scripts/traffic_gen.py --config_path configs/nbody_serve.yaml \
+    --requests 48 --rate 60 --mix "predict=0.8,session=0.2" \
+    --sizes 24,48,96 --sessions 4 --seed 53 --timeout-s 300 \
+    --replicas 2 --chaos "kill@0.5:replica=0;wedge@2.0:replica=1,dur=2" \
+    --slo configs/slo_default.yaml --obs-dir "$obsdir" \
+    | tee /tmp/chaos_last.json || return 1
+  python - <<'EOF' || return 1
+import json
+line = [l for l in open('/tmp/chaos_last.json') if l.strip().startswith('{')][-1]
+rec = json.loads(line)
+ok = (rec.get('value', 0) > 0
+      and rec.get('completed', 0) == rec.get('requests', -1)
+      and rec.get('lost', 1) == 0
+      and all(c.get('ok') for c in rec.get('chaos') or []))
+raise SystemExit(0 if ok else 1)
+EOF
+  mkdir -p docs/artifacts
+  cp /tmp/chaos_last.json "docs/artifacts/chaos_gateway_$stamp.json"
+  python scripts/obs_report.py "$obsdir/obs/events.jsonl" \
+    --slo configs/slo_default.yaml
+}
+export -f chaos_gateway_and_check
+run_bounded chaos_gateway chaos_gateway_and_check
+
 # 3b. machine roofline probe (minutes): copy/matmul/gather/scatter ceilings
 #     + analytic step floor — pairs with the new hbm_gbps field in the bench
 #     line (VERDICT r4 #7) to place every lowering on the memory roofline.

@@ -30,6 +30,18 @@ Target: ``--url http://host:port`` drives an already-running gateway
 boots an in-process gateway from ``--config_path`` (default built-ins)
 on an ephemeral port and still drives it over the real socket.
 
+Chaos: ``--chaos 'kill@0.3:replica=0;swap@1.0:ckpt=/p/b.ckpt'`` fires
+serving faults at fixed offsets into the replay (semicolon-separated
+``action@seconds[:key=val,...]``; actions kill / wedge / latency /
+corrupt reach into the live replica pool via
+distegnn_tpu.testing.serve_faults, swap POSTs the blue/green hot-swap
+through the socket and then fires a fixed probe predict whose
+prediction bytes land in a ``chaos/swap_probe`` event for bitwise
+comparison). Chaos needs the in-process gateway (no ``--url``).
+Clients honor 429/503 ``Retry-After`` headers with bounded retries
+(``--max-retries``), so a failover blip degrades latency instead of
+losing accepted work.
+
 Stdout is EXACTLY one BENCH JSON line:
 
   {"metric": "traffic_p99_ms", "value": <overall p99>, "unit": "ms",
@@ -82,6 +94,47 @@ def parse_mix(spec: str) -> dict:
     if total <= 0:
         raise ValueError(f"traffic mix {spec!r} has no positive weight")
     return {k: mix.get(k, 0.0) / total for k in CLASSES}
+
+
+CHAOS_ACTIONS = ("kill", "wedge", "latency", "swap", "corrupt")
+
+
+def parse_chaos(spec: str):
+    """'kill@0.3:replica=0;swap@1.0:ckpt=/p/b.ckpt' -> events sorted by
+    firing offset, each ``{action, at, kw}``. Args per action: every one
+    takes ``model=`` (default: first served model); kill/wedge/latency
+    take ``replica=`` (kill/wedge default 0, latency default ALL); wedge
+    takes ``dur=`` seconds; latency takes ``s=`` seconds; swap/corrupt
+    take ``ckpt=`` and corrupt ``mode=`` (truncate|garbage|headerless)."""
+    events = []
+    for part in spec.split(";"):
+        part = part.strip()
+        if not part:
+            continue
+        head, _, tail = part.partition(":")
+        action, _, at = head.partition("@")
+        action = action.strip()
+        if action not in CHAOS_ACTIONS:
+            raise ValueError(f"unknown chaos action {action!r} "
+                             f"(known: {', '.join(CHAOS_ACTIONS)})")
+        try:
+            at_s = float(at)
+        except ValueError:
+            raise ValueError(
+                f"chaos action {action!r} needs '@<seconds>'") from None
+        kw = {}
+        for item in tail.split(","):
+            item = item.strip()
+            if not item:
+                continue
+            key, eq, val = item.partition("=")
+            if not eq:
+                raise ValueError(f"bad chaos arg {item!r} (want key=value)")
+            kw[key.strip()] = val.strip()
+        if action in ("swap", "corrupt") and not kw.get("ckpt"):
+            raise ValueError(f"chaos action {action!r} needs ckpt=<path>")
+        events.append({"action": action, "at": at_s, "kw": kw})
+    return sorted(events, key=lambda e: e["at"])
 
 
 def size_sampler(sizes, alpha: float, rng: random.Random):
@@ -231,6 +284,8 @@ def boot_gateway(args, cfg):
             float(cfg.serve.request_timeout_ms), 600_000.0)
     if args.max_batch is not None:
         cfg.serve.max_batch = int(args.max_batch)
+    if args.replicas is not None:
+        cfg.serve.replicas = int(args.replicas)
 
     registry = ModelRegistry.from_config(cfg).start()
     registry.warmup(args.size_list)
@@ -245,36 +300,141 @@ def boot_gateway(args, cfg):
     return gw, server, registry
 
 
+# ---- chaos ------------------------------------------------------------------
+
+def _swap_over_socket(base_url: str, model: str, ckpt: str,
+                      feat_nf: int, edge_attr_nf: int) -> dict:
+    """POST the blue/green hot-swap through the live socket; on success
+    fire one FIXED probe predict (n=24, seed=1234) and log its prediction
+    bytes as a ``chaos/swap_probe`` event, so a test can compare them
+    bitwise against a cold-started engine on the new checkpoint."""
+    import urllib.error
+    import urllib.request
+
+    from distegnn_tpu import obs
+    from distegnn_tpu.serve.buckets import synthetic_graph
+
+    req = urllib.request.Request(
+        base_url.rstrip("/") + f"/v1/models/{model}/swap",
+        data=json.dumps({"checkpoint": str(ckpt)}).encode(),
+        headers={"Content-Type": "application/json"}, method="POST")
+    try:
+        with urllib.request.urlopen(req, timeout=120.0) as resp:
+            status, body = int(resp.status), json.loads(resp.read().decode())
+    except urllib.error.HTTPError as e:
+        status = int(e.code)
+        try:
+            body = json.loads(e.read().decode() or "{}")
+        except ValueError:
+            body = {}
+    out = {"ckpt": str(ckpt), "status": status, "ok": status == 200,
+           "swap": {k: body[k] for k in ("version", "stage", "rolled_back")
+                    if k in body}}
+    if status == 200:
+        g = synthetic_graph(24, seed=1234, feat_nf=feat_nf,
+                            edge_attr_nf=edge_attr_nf)
+        preq = urllib.request.Request(
+            base_url.rstrip("/") + f"/v1/models/{model}/predict",
+            data=predict_payload(g),
+            headers={"Content-Type": "application/json"}, method="POST")
+        with urllib.request.urlopen(preq, timeout=120.0) as resp:
+            pred = json.loads(resp.read().decode())["prediction"]
+        obs.event("chaos/swap_probe", model=model, ckpt=str(ckpt), n=24,
+                  seed=1234, prediction=pred)
+    return out
+
+
+def run_chaos(events, t0: float, registry, base_url: str, models,
+              feat_nf: int, edge_attr_nf: int, record: list) -> None:
+    """Fire the parsed chaos events at their offsets from ``t0``; every
+    firing (or failure to fire) lands in ``record`` and as a
+    ``chaos/inject`` obs event. Injection errors are recorded, never
+    raised — the replay must finish and report regardless."""
+    from distegnn_tpu import obs
+    from distegnn_tpu.testing import serve_faults
+
+    for ev in events:
+        delay = (t0 + ev["at"]) - time.perf_counter()
+        if delay > 0:
+            time.sleep(delay)
+        action, kw = ev["action"], ev["kw"]
+        model = kw.get("model") or models[0]
+        outcome = {"action": action, "at_s": ev["at"], "model": model}
+        try:
+            if action == "kill":
+                rep = int(kw.get("replica", 0))
+                serve_faults.kill_replica(registry, model, rep)
+                outcome.update(replica=rep, ok=True)
+            elif action == "wedge":
+                rep = int(kw.get("replica", 0))
+                dur = float(kw.get("dur", 5.0))
+                serve_faults.wedge_replica(registry, model, dur, rep)
+                outcome.update(replica=rep, dur_s=dur, ok=True)
+            elif action == "latency":
+                rep = int(kw["replica"]) if "replica" in kw else None
+                sec = float(kw.get("s", 0.05))
+                serve_faults.inject_execute_latency(registry, model, sec,
+                                                    replica=rep)
+                outcome.update(replica=rep, seconds=sec, ok=True)
+            elif action == "corrupt":
+                mode = kw.get("mode", "garbage")
+                serve_faults.corrupt_swap_checkpoint(kw["ckpt"], mode)
+                outcome.update(ckpt=kw["ckpt"], mode=mode, ok=True)
+            elif action == "swap":
+                outcome.update(_swap_over_socket(
+                    base_url, model, kw["ckpt"], feat_nf, edge_attr_nf))
+        except Exception as exc:
+            outcome.update(ok=False, error=repr(exc))
+        obs.event("chaos/inject", **outcome)
+        record.append(outcome)
+
+
 # ---- replay -----------------------------------------------------------------
 
-def replay(base_url: str, plan, offsets, timeout_s: float):
+def replay(base_url: str, plan, offsets, timeout_s: float,
+           max_retries: int = 3):
     """Fire the plan open-loop; returns per-request result dicts
-    ``{cls, status, ms, rid}`` (status -1 = transport error) and wall_s."""
+    ``{cls, status, ms, rid, retries}`` (status -1 = transport error) and
+    wall_s. A 429/503 carrying Retry-After is retried after honoring the
+    header (capped at 5 s per wait, ``max_retries`` attempts), so a
+    failover blip shows up as latency, not lost work."""
     import urllib.error
     import urllib.request
 
     results = [None] * len(plan)
 
     def post(i, item):
-        req = urllib.request.Request(
-            base_url.rstrip("/") + item["path"], data=item["body"],
-            headers={"Content-Type": "application/json",
-                     "X-Request-Id": item["rid"]},
-            method="POST")
         t_req = time.perf_counter()
-        status, echoed = -1, None
-        try:
-            with urllib.request.urlopen(req, timeout=timeout_s) as resp:
-                status = int(resp.status)
-                echoed = resp.headers.get("X-Request-Id")
-        except urllib.error.HTTPError as e:
-            status = int(e.code)
-            echoed = e.headers.get("X-Request-Id")
-        except Exception:
-            pass
+        status, echoed, retries = -1, None, 0
+        while True:
+            req = urllib.request.Request(
+                base_url.rstrip("/") + item["path"], data=item["body"],
+                headers={"Content-Type": "application/json",
+                         "X-Request-Id": item["rid"]},
+                method="POST")
+            try:
+                with urllib.request.urlopen(req, timeout=timeout_s) as resp:
+                    status = int(resp.status)
+                    echoed = resp.headers.get("X-Request-Id")
+                break
+            except urllib.error.HTTPError as e:
+                status = int(e.code)
+                echoed = e.headers.get("X-Request-Id")
+                after = e.headers.get("Retry-After")
+                if status in (429, 503) and after and retries < max_retries:
+                    try:
+                        wait = min(max(float(after), 0.0), 5.0)
+                    except ValueError:
+                        wait = 0.5
+                    retries += 1
+                    time.sleep(wait)
+                    continue
+                break
+            except Exception:
+                break
         results[i] = {"cls": item["cls"], "status": status,
                       "ms": (time.perf_counter() - t_req) * 1e3,
-                      "rid": echoed or item["rid"]}
+                      "rid": echoed or item["rid"], "retries": retries}
 
     threads = []
     t0 = time.perf_counter()
@@ -291,7 +451,8 @@ def replay(base_url: str, plan, offsets, timeout_s: float):
     for i, item in enumerate(plan):   # a thread that never returned = error
         if results[i] is None:
             results[i] = {"cls": item["cls"], "status": -1,
-                          "ms": timeout_s * 1e3, "rid": item["rid"]}
+                          "ms": timeout_s * 1e3, "rid": item["rid"],
+                          "retries": 0}
     return results, wall
 
 
@@ -406,6 +567,14 @@ def main(argv=None) -> int:
                     help="per-request client timeout")
     ap.add_argument("--max-batch", type=int, default=None,
                     help="override serve.max_batch (in-process gateway only)")
+    ap.add_argument("--replicas", type=int, default=None,
+                    help="override serve.replicas (in-process gateway only)")
+    ap.add_argument("--chaos", type=str, default=None,
+                    help="serving fault schedule, e.g. 'kill@0.3:replica=0;"
+                         "swap@1.0:ckpt=/p/b.ckpt' (in-process gateway only)")
+    ap.add_argument("--max-retries", type=int, default=3,
+                    help="client retries per request on 429/503 that carry "
+                         "Retry-After (0 disables)")
     ap.add_argument("--slo", type=str, default=None,
                     help="SLO spec file; default: the config's slo: section")
     ap.add_argument("--obs-dir", type=str, default="logs/traffic_gen",
@@ -414,6 +583,16 @@ def main(argv=None) -> int:
     args.size_list = [int(s) for s in args.sizes.split(",") if s.strip()]
     if not args.size_list:
         print("traffic_gen: --sizes is empty", file=sys.stderr)  # noqa: obs-print
+        return 2
+    try:
+        chaos_events = parse_chaos(args.chaos) if args.chaos else []
+    except ValueError as exc:
+        print(f"traffic_gen: {exc}", file=sys.stderr)  # noqa: obs-print
+        return 2
+    if chaos_events and args.url:
+        print("traffic_gen: --chaos needs the in-process gateway (the "
+              "injectors reach into the live registry); drop --url",
+              file=sys.stderr)  # noqa: obs-print
         return 2
 
     from distegnn_tpu import obs
@@ -438,8 +617,7 @@ def main(argv=None) -> int:
         gw, server, registry = boot_gateway(args, cfg)
         base_url = gw.url("")
         models = registry.names()
-        rollout_models = [n for n, e in registry.items()
-                          if getattr(e.engine, "_rollout_opts", None)]
+        rollout_models = [n for n, e in registry.items() if e.rollout_enabled]
 
     feat_nf = int(cfg.model.node_feat_nf)
     edge_attr_nf = int(cfg.model.edge_attr_nf)
@@ -450,7 +628,22 @@ def main(argv=None) -> int:
               burst_on_s=args.burst_on_s, burst_off_s=args.burst_off_s,
               target=("remote" if args.url else "inproc"))
 
-    results, wall = replay(base_url, plan, offsets, args.timeout_s)
+    chaos_record: list = []
+    chaos_thread = None
+    if chaos_events:
+        obs.event("chaos/plan", events=[{"action": e["action"],
+                                         "at_s": e["at"]}
+                                        for e in chaos_events])
+        chaos_thread = threading.Thread(
+            target=run_chaos,
+            args=(chaos_events, time.perf_counter(), registry, base_url,
+                  models, feat_nf, edge_attr_nf, chaos_record),
+            name="tg-chaos", daemon=True)
+        chaos_thread.start()
+    results, wall = replay(base_url, plan, offsets, args.timeout_s,
+                           max_retries=args.max_retries)
+    if chaos_thread is not None:
+        chaos_thread.join(timeout=args.timeout_s + 60.0)
     prom_text = scrape_metrics(base_url)
     if gw is not None:
         gw.drain()
@@ -479,6 +672,9 @@ def main(argv=None) -> int:
                       / max(len(results), 1), 6),
         "errors": sum(1 for r in results if r["status"] >= 500
                       or r["status"] < 0),
+        "lost": sum(1 for r in results if r["status"] < 0),
+        "retries_total": sum(r.get("retries", 0) for r in results),
+        "chaos": chaos_record or None,
         "batch_fill": stats.get("batch_fill"),
         "session_hit_rate": stats.get("session_hit_rate"),
         "offered_rate": args.rate,

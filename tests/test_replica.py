@@ -1,0 +1,543 @@
+"""Supervised replica pool (serve/replica.py + supervisor.py) and blue/green
+hot-swap (registry.py): round-robin admission parity, at-most-once failover
+on crash and wedge, exponential backoff + circuit breaker driven through
+deterministic supervisor ticks, typed all-replicas-down shedding with
+Retry-After, checksummed swap with NaN-canary rollback, and the queue's
+windowed dispatcher-restart budget — all CPU, no sockets except the
+per-model shed isolation test which drives a live gateway."""
+
+import threading
+import time
+from types import SimpleNamespace
+
+import jax
+import numpy as np
+import pytest
+
+from distegnn_tpu.models.fast_egnn import FastEGNN
+from distegnn_tpu.obs.metrics import MetricsRegistry
+from distegnn_tpu.ops.graph import pad_graphs
+from distegnn_tpu.serve import (InferenceEngine, ModelUnavailableError,
+                                RequestQueue, ServeMetrics, synthetic_graph)
+from distegnn_tpu.serve.registry import (ModelEntry, ModelRegistry, SwapError,
+                                         SwapInProgressError)
+from distegnn_tpu.serve.replica import Replica, ReplicaSet, _Tracked
+from distegnn_tpu.serve.queue import ServeFuture
+from distegnn_tpu.testing import corrupt_swap_checkpoint
+from distegnn_tpu.train.checkpoint import save_checkpoint
+
+pytestmark = pytest.mark.serve
+
+
+@pytest.fixture(scope="module")
+def tiny():
+    model = FastEGNN(node_feat_nf=1, edge_attr_nf=2, hidden_nf=16,
+                     virtual_channels=2, n_layers=2)
+    graph = synthetic_graph(26, seed=5)
+    tight = pad_graphs([graph], node_bucket=1, edge_bucket=1)
+    params = model.init(jax.random.PRNGKey(0), tight)
+    x, _ = model.apply(params, tight)
+    return SimpleNamespace(model=model, graph=graph, params=params,
+                           ref=np.asarray(x[0]))
+
+
+def _mk_rset(tiny, n, sup=None, name="m", **q_kw):
+    """N shared-nothing (engine, queue) replicas with one shared metrics;
+    the supervisor heartbeat is parked at an hour so tests drive tick()
+    with synthetic clocks instead of racing a background thread."""
+    metrics = ServeMetrics()
+    kw = dict(batch_deadline_ms=2.0, queue_capacity=32,
+              request_timeout_ms=30_000.0, result_margin_s=30.0)
+    kw.update(q_kw)
+    pairs = []
+    for _ in range(n):
+        eng = InferenceEngine(tiny.model, tiny.params, max_batch=2,
+                              metrics=metrics)
+        pairs.append((eng, RequestQueue(eng, metrics=metrics, **kw)))
+    opts = dict(heartbeat_s=3600.0)
+    opts.update(sup or {})
+    return ReplicaSet(name, pairs, supervisor_opts=opts)
+
+
+def _g(tiny):
+    return dict(tiny.graph)
+
+
+# ---- admission & round robin ------------------------------------------------
+
+def test_unsupervised_set_passes_through_queue_errors(tiny):
+    """A never-started set surfaces replica 0's own admission error (the
+    legacy single-queue contract tests and benches rely on)."""
+    rset = _mk_rset(tiny, 2)
+    with pytest.raises(RuntimeError, match="not started"):
+        rset.submit(_g(tiny))
+
+
+def test_round_robin_parity_across_replicas(tiny):
+    rset = _mk_rset(tiny, 2).start()
+    try:
+        futs = [rset.submit(_g(tiny)) for _ in range(4)]
+        for f in futs:
+            np.testing.assert_allclose(f.result(timeout=60.0), tiny.ref,
+                                       atol=1e-4, rtol=0)
+        # both replicas actually served traffic
+        assert {f.meta["replica"] for f in futs} == {0, 1}
+        assert rset.available() == 2
+    finally:
+        rset.stop()
+
+
+def test_untrack_claims_exactly_once(tiny):
+    """The at-most-once protocol's core: compare-and-pop means exactly one
+    of the competing claimers (done-callback vs supervisor drain) wins."""
+    rset = _mk_rset(tiny, 1)
+    rec = _Tracked("predict", {}, None, None, ServeFuture())
+    r = rset.replicas[0]
+    r.track(rec)
+    assert r.untrack(rec) is True
+    assert r.untrack(rec) is False
+    assert r.drain_inflight() == []
+
+
+# ---- failover ---------------------------------------------------------------
+
+def test_failover_on_kill_is_at_most_once(tiny):
+    """Killing the replica holding an in-flight request moves it to the
+    survivor exactly once; the later supervisor pass claims nothing."""
+    rset = _mk_rset(tiny, 2).start()
+    try:
+        # park both dispatchers so the request stays claimable in-flight
+        for r in rset.replicas:
+            r.queue.wedge(1.0)
+        fut = rset.submit(_g(tiny))
+        hit = next(r for r in rset.replicas if r.inflight_count() == 1)
+        other = rset.replicas[1 - hit.idx]
+        hit.queue.kill(reason="chaos test")
+        out = fut.result(timeout=60.0)
+        np.testing.assert_allclose(out, tiny.ref, atol=1e-4, rtol=0)
+        assert fut.meta["replica"] == other.idx
+        assert rset.metrics.snapshot()["requests_failed_over"] == 1
+        # the supervisor's crash pass finds nothing left to claim
+        rset.supervisor.tick()
+        assert rset.metrics.snapshot()["requests_failed_over"] == 1
+        assert hit.state in ("backoff", "broken")
+        assert rset.available() == 1
+    finally:
+        rset.stop()
+
+
+def test_wedge_detected_and_failed_over(tiny):
+    """A dispatcher with queued work but no batch progress past the wedge
+    deadline is abandoned: its in-flight request completes on the survivor
+    and the wedged replica is scheduled for restart."""
+    rset = _mk_rset(tiny, 2, sup=dict(wedge_timeout_s=0.4)).start()
+    try:
+        for r in rset.replicas:
+            r.queue.wedge(30.0)
+        fut = rset.submit(_g(tiny))
+        hit = next(r for r in rset.replicas if r.inflight_count() == 1)
+        other = rset.replicas[1 - hit.idx]
+        other.queue.wedge(0.0)          # survivor resumes immediately
+        time.sleep(0.6)                 # > wedge_timeout_s with no progress
+        rset.supervisor.tick()
+        out = fut.result(timeout=60.0)
+        np.testing.assert_allclose(out, tiny.ref, atol=1e-4, rtol=0)
+        assert fut.meta["replica"] == other.idx
+        assert hit.state == "backoff" and hit.last_reason == "wedge"
+        assert rset.metrics.snapshot()["requests_failed_over"] == 1
+        assert not hit.queue.alive()    # killed, not left running wedged
+    finally:
+        rset.stop()
+
+
+def test_all_replicas_down_sheds_typed_with_retry_hint(tiny):
+    rset = _mk_rset(tiny, 1, sup=dict(backoff_base_s=0.5)).start()
+    try:
+        rset.replicas[0].queue.kill(reason="boom")
+        with pytest.raises(ModelUnavailableError) as ei:
+            rset.submit(_g(tiny))
+        assert ei.value.model == "m"
+        assert ei.value.retry_after_s == pytest.approx(1.0)  # not yet ticked
+        rset.supervisor.tick()          # crash noticed, restart scheduled
+        with pytest.raises(ModelUnavailableError) as ei:
+            rset.submit(_g(tiny))
+        assert 0.1 <= ei.value.retry_after_s <= 0.51
+    finally:
+        rset.stop()
+
+
+# ---- supervisor state machine (synthetic clock) -----------------------------
+
+def test_supervisor_backoff_doubles_then_breaker_opens(tiny):
+    rset = _mk_rset(tiny, 1, sup=dict(backoff_base_s=0.5, backoff_max_s=8.0,
+                                      breaker_threshold=3,
+                                      breaker_cooldown_s=30.0,
+                                      healthy_reset_s=5.0)).start()
+    sup, r = rset.supervisor, rset.replicas[0]
+    try:
+        t = 100.0
+        r.queue.kill(reason="c1")
+        sup.tick(now=t)
+        assert r.state == "backoff" and r.failures == 1
+        assert r.next_restart_at == pytest.approx(t + 0.5)
+        sup.tick(now=t + 0.4)           # backoff not elapsed: still down
+        assert r.state == "backoff" and r.restarts == 0
+        sup.tick(now=t + 0.6)           # restart on a FRESH queue
+        assert r.state == "running" and r.restarts == 1 and r.queue.alive()
+        np.testing.assert_allclose(
+            rset.submit(_g(tiny)).result(timeout=60.0), tiny.ref,
+            atol=1e-4, rtol=0)
+        r.queue.kill(reason="c2")       # second failure: doubled backoff
+        sup.tick(now=t + 1.0)
+        assert r.failures == 2
+        assert r.next_restart_at == pytest.approx(t + 2.0)  # 0.5 * 2^1 later
+        sup.tick(now=t + 2.1)
+        assert r.state == "running"
+        r.queue.kill(reason="c3")       # third: breaker opens, long cooldown
+        sup.tick(now=t + 4.0)
+        assert r.state == "broken" and r.failures == 3
+        assert r.next_restart_at == pytest.approx(t + 34.0)
+        sup.tick(now=t + 33.9)
+        assert r.state == "broken"
+        sup.tick(now=t + 34.1)          # half-open attempt succeeds
+        assert r.state == "running"
+        sup.tick(now=t + 35.0)          # healthy but < healthy_reset_s
+        assert r.failures == 3
+        sup.tick(now=t + 40.0)          # healthy interval closes the breaker
+        assert r.failures == 0
+        assert rset.metrics.snapshot()["replica_restarts"] == 3
+    finally:
+        rset.stop()
+
+
+# ---- blue/green hot-swap ----------------------------------------------------
+
+def _mk_entry(tiny, n=2, name="m"):
+    metrics = ServeMetrics()
+    kw = dict(batch_deadline_ms=2.0, request_timeout_ms=30_000.0)
+    engine = InferenceEngine(tiny.model, tiny.params, max_batch=2,
+                             metrics=metrics)
+    queue = RequestQueue(engine, metrics=metrics, **kw)
+    extra = []
+    for _ in range(n - 1):
+        e2 = InferenceEngine(tiny.model, tiny.params, max_batch=2,
+                             metrics=metrics)
+        extra.append((e2, RequestQueue(e2, metrics=metrics, **kw)))
+    return ModelEntry(name, engine, queue, feat_nf=1, edge_attr_nf=2,
+                      extra_replicas=extra,
+                      supervisor_opts=dict(heartbeat_s=3600.0))
+
+
+def _save_params(path, params):
+    save_checkpoint(str(path),
+                    SimpleNamespace(params=params, opt_state={}, step=0),
+                    epoch=0)
+
+
+def test_swap_flips_every_replica_bitwise(tiny, tmp_path):
+    """A successful swap serves the NEW checkpoint from every replica with
+    predictions bitwise-identical to a cold-started engine on it."""
+    entry = _mk_entry(tiny, n=2)
+    entry.start()
+    entry.warmup([26])
+    try:
+        params_b = jax.tree.map(lambda x: x * 1.0625, tiny.params)
+        ck = tmp_path / "b.ckpt"
+        _save_params(ck, params_b)
+        info = entry.swap(str(ck))
+        assert info["version"] == 1 and info["replicas"] == 2
+        assert info["rungs_canaried"] >= 1
+        assert entry.params_version == 1 and entry.checkpoint == str(ck)
+
+        futs = [entry.queue.submit(_g(tiny)) for _ in range(2)]
+        outs = [f.result(timeout=60.0) for f in futs]
+        assert {f.meta["replica"] for f in futs} == {0, 1}
+
+        m2 = ServeMetrics()
+        cold_eng = InferenceEngine(tiny.model, params_b, max_batch=2,
+                                   metrics=m2)
+        with RequestQueue(cold_eng, batch_deadline_ms=2.0,
+                          request_timeout_ms=30_000.0, metrics=m2) as cold_q:
+            cold_out = cold_q.submit(_g(tiny)).result(timeout=60.0)
+        for out in outs:
+            np.testing.assert_array_equal(np.asarray(out),
+                                          np.asarray(cold_out))
+    finally:
+        entry.stop()
+
+
+def test_swap_corrupt_checkpoint_fails_at_restore(tiny, tmp_path):
+    entry = _mk_entry(tiny, n=1)
+    entry.start()
+    entry.warmup([26])
+    try:
+        old = entry.engine.params
+        ck = tmp_path / "bad.ckpt"
+        _save_params(ck, tiny.params)
+        corrupt_swap_checkpoint(str(ck), mode="garbage")
+        with pytest.raises(SwapError) as ei:
+            entry.swap(str(ck))
+        assert ei.value.stage == "restore" and ei.value.rolled_back
+        assert entry.engine.params is old and entry.params_version == 0
+        np.testing.assert_allclose(
+            entry.queue.submit(_g(tiny)).result(timeout=60.0), tiny.ref,
+            atol=1e-4, rtol=0)
+    finally:
+        entry.stop()
+
+
+def test_swap_nan_canary_rolls_back_flipped_replicas(tiny, tmp_path):
+    entry = _mk_entry(tiny, n=2)
+    entry.start()
+    entry.warmup([26])
+    try:
+        old = entry.engine.params
+        params_nan = jax.tree.map(lambda x: np.full_like(x, np.nan),
+                                  tiny.params)
+        ck = tmp_path / "nan.ckpt"
+        _save_params(ck, params_nan)
+        with pytest.raises(SwapError) as ei:
+            entry.swap(str(ck))
+        assert ei.value.stage == "canary" and ei.value.rolled_back
+        assert entry.params_version == 0
+        for r in entry.replicas.replicas:
+            assert r.engine.params is old
+        np.testing.assert_allclose(
+            entry.queue.submit(_g(tiny)).result(timeout=60.0), tiny.ref,
+            atol=1e-4, rtol=0)
+    finally:
+        entry.stop()
+
+
+def test_swap_one_at_a_time(tiny, tmp_path):
+    entry = _mk_entry(tiny, n=1)
+    entry.start()
+    entry.warmup([26])
+    try:
+        ck = tmp_path / "b.ckpt"
+        _save_params(ck, tiny.params)
+        assert entry._swap_lock.acquire(blocking=False)
+        try:
+            with pytest.raises(SwapInProgressError):
+                entry.swap(str(ck))
+        finally:
+            entry._swap_lock.release()
+    finally:
+        entry.stop()
+
+
+# ---- per-model shed isolation over a live socket ----------------------------
+
+def test_gateway_sheds_only_the_dead_model(tiny):
+    """With model 'a' fully down, its route 503s typed with Retry-After
+    while model 'b' keeps serving; /readyz reports degraded; /metrics
+    exposes the per-replica up gauges."""
+    import json as _json
+    import urllib.error
+    import urllib.request
+
+    from distegnn_tpu.serve.transport import Gateway
+
+    ea = _mk_entry(tiny, n=1, name="a")
+    eb = _mk_entry(tiny, n=1, name="b")
+    reg = ModelRegistry({"a": ea, "b": eb})
+    reg.start()
+    reg.warmup([26])
+    gw = Gateway(reg, port=0, max_inflight=16,
+                 metrics_registry=MetricsRegistry())
+    thread = threading.Thread(target=gw.serve_forever, daemon=True)
+    thread.start()
+
+    def post(path, payload):
+        req = urllib.request.Request(
+            gw.url(path), data=_json.dumps(payload).encode(),
+            headers={"Content-Type": "application/json"}, method="POST")
+        try:
+            with urllib.request.urlopen(req, timeout=60.0) as r:
+                return r.status, dict(r.headers), _json.load(r)
+        except urllib.error.HTTPError as e:
+            return e.code, dict(e.headers), _json.load(e)
+
+    g = tiny.graph
+    payload = {"positions": g["loc"].tolist(),
+               "velocities": g["vel"].tolist(),
+               "node_feat": g["node_feat"].tolist(),
+               "edge_index": g["edge_index"].tolist(),
+               "edge_attr": g["edge_attr"].tolist()}
+    try:
+        ea.replicas.replicas[0].queue.kill(reason="chaos")
+        status, headers, body = post("/v1/models/a/predict", payload)
+        assert status == 503 and body["type"] == "ModelUnavailable"
+        assert body["model"] == "a"
+        assert float(headers["Retry-After"]) >= 0.1
+        status, _, body = post("/v1/models/b/predict", payload)
+        assert status == 200
+        np.testing.assert_allclose(np.asarray(body["prediction"]), tiny.ref,
+                                   atol=1e-4, rtol=0)
+        with urllib.request.urlopen(gw.url("/readyz"), timeout=30.0) as r:
+            rz = _json.load(r)
+            assert r.status == 200
+        assert rz["degraded"] is True
+        assert rz["models"]["a"]["ready"] is False
+        assert rz["models"]["a"]["replicas_available"] == 0
+        assert rz["models"]["b"]["ready"] is True
+        with urllib.request.urlopen(gw.url("/metrics"), timeout=30.0) as r:
+            prom = r.read().decode()
+        gauges = {ln.rsplit(" ", 1)[0]: float(ln.rsplit(" ", 1)[1])
+                  for ln in prom.splitlines()
+                  if ln and not ln.startswith("#")}
+        up = {k: v for k, v in gauges.items() if "replica" in k}
+        assert any(k.endswith("replica_a_0_up") and v == 0.0
+                   for k, v in up.items())
+        assert any(k.endswith("replica_b_0_up") and v == 1.0
+                   for k, v in up.items())
+        assert any(k.endswith("replicas_a_available") and v == 0.0
+                   for k, v in up.items())
+    finally:
+        gw.drain()
+        thread.join(timeout=30.0)
+        gw.close()
+
+
+# ---- the chaos drill: kill + live hot-swap under replayed traffic ----------
+
+def test_chaos_drill_kill_and_swap_under_traffic(tmp_path):
+    """The PR's acceptance drill, all from ONE ``traffic_gen --chaos`` run:
+    with 2 replicas, a replica kill mid-replay loses ZERO accepted
+    requests (failover + Retry-After retries absorb the blip inside the
+    declared SLO bound), and a live blue/green hot-swap under that same
+    traffic serves predictions bitwise-identical to a cold-started engine
+    on the new checkpoint (asserted via the run's chaos/swap_probe
+    event)."""
+    import base64
+    import json as _json
+    import os
+    import subprocess
+    import sys
+
+    from distegnn_tpu.config import ConfigDict, _DEFAULTS
+    from distegnn_tpu.serve import engine_from_config
+    from distegnn_tpu.serve.registry import ModelRegistry
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    cfg = ConfigDict(_DEFAULTS)
+    # the same deterministic init path the in-process gateway runs (default
+    # config + seed), so checkpoint B is structurally identical to the
+    # params the subprocess gateway serves
+    entry = ModelRegistry.from_config(cfg).get("default")
+    params_b = jax.tree.map(lambda x: x * 1.0625, entry.engine.params)
+    ck = tmp_path / "b.ckpt"
+    _save_params(ck, params_b)
+    spec = tmp_path / "slo.yaml"
+    spec.write_text("slo:\n"
+                    "  routes:\n"
+                    "    predict:\n"
+                    "      p99_ms: 60000\n"
+                    "  error_rate_max: 0.0\n")
+    obs_dir = tmp_path / "tg"
+
+    r = subprocess.run(
+        [sys.executable, os.path.join(repo, "scripts", "traffic_gen.py"),
+         "--requests", "24", "--rate", "40", "--mix", "predict=1.0",
+         "--sizes", "24", "--replicas", "2", "--seed", "7",
+         "--chaos", f"kill@0.25:replica=0;swap@0.9:ckpt={ck}",
+         "--slo", str(spec), "--obs-dir", str(obs_dir)],
+        capture_output=True, text=True, cwd=repo, timeout=420,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"})
+    assert r.returncode == 0, r.stderr
+    lines = [ln for ln in r.stdout.strip().splitlines() if ln]
+    assert len(lines) == 1, r.stdout
+    rec = _json.loads(lines[0])
+
+    # zero accepted futures lost through the kill; error blip within bound
+    assert rec["completed"] == 24 and rec["lost"] == 0
+    assert rec["errors"] == 0
+    assert rec["slo"]["pass"] is True, rec["slo"]
+    by_action = {c["action"]: c for c in rec["chaos"]}
+    assert by_action["kill"]["ok"] is True
+    assert by_action["swap"]["ok"] is True
+    assert by_action["swap"]["swap"]["version"] == 1
+
+    # the probe prediction from the swapped live gateway, bit for bit
+    probe = None
+    with open(obs_dir / "obs" / "events.jsonl") as f:
+        for line in f:
+            e = _json.loads(line)
+            if e.get("name") == "chaos/swap_probe":
+                probe = e
+    assert probe is not None, "swap probe never fired"
+    pd = probe["prediction"]
+    live = np.frombuffer(base64.b64decode(pd["b64"]),
+                         dtype="<f4").reshape(pd["shape"])
+
+    # cold-started engine on checkpoint B, fed the byte-identical probe
+    g = synthetic_graph(24, seed=1234, feat_nf=int(cfg.model.node_feat_nf),
+                        edge_attr_nf=int(cfg.model.edge_attr_nf))
+    for k in ("loc", "vel", "node_feat", "edge_attr"):
+        g[k] = np.ascontiguousarray(g[k], dtype="<f4")
+    g["edge_index"] = np.ascontiguousarray(g["edge_index"], dtype="<i4")
+    from distegnn_tpu.models.registry import get_model
+
+    model = get_model(cfg.model, dataset_name=cfg.data.dataset_name)
+    eng, q = engine_from_config(cfg, model, params=params_b)
+    with q:
+        cold = q.submit(g).result(timeout=120.0)
+    np.testing.assert_array_equal(live, np.asarray(cold, dtype="<f4"))
+
+
+# ---- queue restart budget (windowed) ---------------------------------------
+
+class _CrashingMetrics(ServeMetrics):
+    """set_queue_depth raises ``bombs`` times — a deterministic dispatcher
+    loop crash (a bug, not an engine error, so the restart budget applies)."""
+
+    def __init__(self, bombs=0):
+        super().__init__()
+        self.bombs = bombs
+
+    def set_queue_depth(self, depth):
+        if self.bombs > 0:
+            self.bombs -= 1
+            raise RuntimeError("injected dispatcher crash")
+        super().set_queue_depth(depth)
+
+
+class _FakeEngine:
+    def __init__(self, metrics, max_batch=4):
+        from distegnn_tpu.serve import BucketLadder
+
+        self.ladder = BucketLadder(max_nodes=256, max_edges=1024)
+        self.metrics = metrics
+        self.max_batch = max_batch
+
+    def predict_batch(self, graphs, bucket=None, request_ids=None):
+        return [np.zeros((g["loc"].shape[0], 3)) for g in graphs]
+
+
+def _fake_graph():
+    return {"loc": np.zeros((10, 3)),
+            "edge_index": np.zeros((2, 20), np.int32)}
+
+
+def test_restart_budget_replenishes_after_quiet_interval(monkeypatch):
+    """Crash bursts separated by a healthy interval never exhaust the
+    dispatcher restart budget: only crashes inside the sliding window
+    count, so transient crash clusters spread over time keep serving."""
+    from distegnn_tpu.serve import queue as qmod
+
+    monkeypatch.setattr(qmod, "_RESTART_WINDOW_S", 0.3)
+    metrics = _CrashingMetrics(bombs=qmod._MAX_WORKER_RESTARTS)
+    eng = _FakeEngine(metrics)
+    q = RequestQueue(eng, batch_deadline_ms=5.0).start()
+    try:
+        out = q.submit(_fake_graph()).result(timeout=10.0)
+        assert out.shape == (10, 3)     # survived a full burst of 3
+        assert metrics.snapshot()["worker_restarts"] == 3
+        time.sleep(0.4)                 # crash times age out of the window
+        metrics.bombs = qmod._MAX_WORKER_RESTARTS
+        out = q.submit(_fake_graph()).result(timeout=10.0)
+        assert out.shape == (10, 3)     # replenished: a second burst of 3
+        assert q.alive()
+        assert metrics.snapshot()["worker_restarts"] == 6
+    finally:
+        q.stop()

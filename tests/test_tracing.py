@@ -414,3 +414,40 @@ def test_traffic_gen_e2e_bench_line_and_waterfall(tmp_path):
          events_path, "--request", "nope"],
         capture_output=True, text=True, env=env, cwd=REPO, timeout=120)
     assert r3.returncode == 1 and "not found" in r3.stderr
+
+
+def test_traffic_gen_chaos_leg_slo_verdict_through_kill(tmp_path):
+    """The chaos leg of the drill: a replica kill injected mid-replay
+    (2 replicas) keeps the embedded SLO verdict green — zero lost
+    requests, error rate inside the declared bound — and the injection
+    plus failover land as typed events in the SAME stream the verdict
+    was computed from."""
+    env = {**os.environ, "JAX_PLATFORMS": "cpu"}
+    obs_dir = tmp_path / "tg"
+    spec = tmp_path / "slo.yaml"
+    spec.write_text("slo:\n"
+                    "  routes:\n"
+                    "    predict:\n"
+                    "      p99_ms: 60000\n"
+                    "  error_rate_max: 0.0\n")
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO, "scripts", "traffic_gen.py"),
+         "--requests", "16", "--rate", "40", "--mix", "predict=1.0",
+         "--sizes", "24", "--replicas", "2", "--seed", "13",
+         "--chaos", "kill@0.2:replica=0",
+         "--slo", str(spec), "--obs-dir", str(obs_dir)],
+        capture_output=True, text=True, env=env, cwd=REPO, timeout=420)
+    assert r.returncode == 0, r.stderr
+
+    lines = [ln for ln in r.stdout.strip().splitlines() if ln]
+    assert len(lines) == 1, r.stdout
+    rec = json.loads(lines[0])
+    assert rec["completed"] == 16 and rec["lost"] == 0
+    assert rec["slo"]["pass"] is True and rec["slo"]["rules"] == 2
+    assert rec["chaos"] == [{"action": "kill", "at_s": 0.2,
+                             "model": "default", "replica": 0, "ok": True}]
+    assert "overall: PASS" in r.stderr
+
+    events = read_events(str(obs_dir / "obs" / "events.jsonl"))
+    names = {e["name"] for e in events}
+    assert "chaos/inject" in names and "bench/result" in names

@@ -207,13 +207,29 @@ _DEFAULTS: dict = {
         # round-robin ReplicaSet; >= 2 enables failover of in-flight
         # requests when a replica crashes or wedges
         "replicas": 1,
+        # replica execution backend: 'thread' keeps every replica's engine
+        # in the gateway process; 'process' moves each replica into an
+        # out-of-process worker child (serve/worker.py — crash/OOM/GIL
+        # isolation under the same supervision contract; predictions stay
+        # bitwise-identical to the thread backend)
+        "workers": "thread",
+        # process-worker knobs (only read when workers: process): spawn
+        # handshake budget (child jax import + engine build + warm rungs),
+        # child heartbeat cadence, and the SIGTERM->SIGKILL escalation grace
+        "worker": {
+            "spawn_timeout_s": 120.0,
+            "heartbeat_s": 0.5,
+            "kill_grace_s": 3.0,
+        },
         # replica supervisor knobs (serve/supervisor.py): heartbeat cadence,
-        # wedge (no batch progress) deadline, restart exponential backoff,
+        # wedge (no batch progress) deadline, worker heartbeat-staleness
+        # deadline (process backend only), restart exponential backoff,
         # and the per-replica circuit breaker. Keys are splatted into
-        # ReplicaSupervisor(**...), so only these seven are accepted.
+        # ReplicaSupervisor(**...), so only these eight are accepted.
         "supervisor": {
             "heartbeat_s": 0.25,
             "wedge_timeout_s": 60.0,
+            "worker_heartbeat_timeout_s": 10.0,
             "backoff_base_s": 0.5,
             "backoff_max_s": 30.0,
             "breaker_threshold": 3,
@@ -534,12 +550,29 @@ def validate_config(cfg: ConfigDict) -> None:
                              "a multiple of 512 (the kernel edge tile)")
     if int(s.get("replicas", 1) or 1) < 1:
         raise ValueError("serve.replicas must be >= 1")
+    if str(s.get("workers", "thread") or "thread") not in ("thread",
+                                                           "process"):
+        raise ValueError("serve.workers must be 'thread' or 'process'")
+    w = s.get("worker")
+    if w is not None:
+        if not isinstance(w, Mapping):
+            raise ValueError("serve.worker must be null or a mapping of "
+                             "process-worker knobs")
+        wknown = ("spawn_timeout_s", "heartbeat_s", "kill_grace_s")
+        for key in w:
+            if key not in wknown:
+                raise ValueError(f"serve.worker: unknown key {key!r} "
+                                 f"(accepted: {', '.join(wknown)})")
+        for key in wknown:
+            if key in w and float(w[key]) <= 0:
+                raise ValueError(f"serve.worker.{key} must be > 0")
     sup = s.get("supervisor")
     if sup is not None:
         if not isinstance(sup, Mapping):
             raise ValueError("serve.supervisor must be null or a mapping of "
                              "ReplicaSupervisor kwargs")
-        known = ("heartbeat_s", "wedge_timeout_s", "backoff_base_s",
+        known = ("heartbeat_s", "wedge_timeout_s",
+                 "worker_heartbeat_timeout_s", "backoff_base_s",
                  "backoff_max_s", "breaker_threshold", "breaker_cooldown_s",
                  "healthy_reset_s")
         for key in sup:

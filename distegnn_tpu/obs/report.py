@@ -52,6 +52,30 @@ def load_events(path: str) -> Tuple[List[Dict[str, Any]], int]:
     return events, bad
 
 
+def load_run_events(path: str) -> Tuple[List[Dict[str, Any]], int,
+                                        List[str]]:
+    """Load one run's FULL stream: the named file plus any sibling
+    ``events_worker_*.jsonl`` files (serving worker children write their
+    own sinks next to the parent's — docs/SERVING.md "Worker processes"),
+    merged and ts-sorted so a request that crossed the process boundary
+    stitches into one waterfall. Returns (events, n_bad_lines, files)."""
+    import glob
+    import os
+
+    files = [path]
+    sibling_glob = os.path.join(os.path.dirname(path) or ".",
+                                "events_worker_*.jsonl")
+    files.extend(sorted(p for p in glob.glob(sibling_glob) if p != path))
+    events: List[Dict[str, Any]] = []
+    bad = 0
+    for p in files:
+        evs, b = load_events(p)
+        events.extend(evs)
+        bad += b
+    events.sort(key=lambda e: float(e.get("ts", 0.0)))
+    return events, bad, files
+
+
 def _named(events, name):
     return [e for e in events if e.get("name") == name]
 

@@ -204,7 +204,8 @@ def get_tracer() -> Tracer:
 def configure(log_dir: Optional[str] = None, enable: bool = True,
               per_host: bool = False, buffer_events: int = 256,
               flush_interval_s: float = 2.0,
-              tags: Optional[Dict[str, Any]] = None) -> Tracer:
+              tags: Optional[Dict[str, Any]] = None,
+              filename: Optional[str] = None) -> Tracer:
     """(Re)bind the global tracer.
 
     ``enable=False`` or ``log_dir=None`` installs a sinkless tracer: spans and
@@ -214,12 +215,20 @@ def configure(log_dir: Optional[str] = None, enable: bool = True,
     process writes ``<log_dir>/events_p<i>.jsonl``. Every record is tagged
     ``proc``/``host`` (plus any extra ``tags``) so multi-host streams merge
     unambiguously.
+
+    ``filename`` overrides the sink file name outright and always writes
+    (no process-0 gating) — the serving worker children reuse this per-host
+    machinery with worker-scoped names (``events_worker_<model>_<idx>.jsonl``
+    next to the parent's ``events.jsonl``), so obs_report can stitch one
+    request waterfall across the process boundary.
     """
     global _tracer
     pidx = _process_index()
     writer = None
-    if enable and log_dir is not None and (per_host or pidx == 0):
-        name = f"events_p{pidx}.jsonl" if per_host else "events.jsonl"
+    if enable and log_dir is not None and (per_host or filename is not None
+                                           or pidx == 0):
+        name = filename or (f"events_p{pidx}.jsonl" if per_host
+                            else "events.jsonl")
         writer = EventWriter(os.path.join(log_dir, name),
                              buffer_events=buffer_events,
                              flush_interval_s=flush_interval_s)

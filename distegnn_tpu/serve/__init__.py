@@ -16,9 +16,10 @@ from distegnn_tpu.serve.metrics import ServeMetrics
 from distegnn_tpu.serve.prep import PrepPlan, PrepResult, SessionPrepCache
 from distegnn_tpu.serve.queue import (DispatcherCrashError, QueueFullError,
                                       RequestQueue, RequestTimeoutError,
-                                      ServeFuture)
+                                      ServeFuture, WorkerLostError)
 from distegnn_tpu.serve.replica import (ModelUnavailableError, Replica,
-                                        ReplicaSet)
+                                        ReplicaSet, WorkerQueue,
+                                        WorkerReplica)
 from distegnn_tpu.serve.supervisor import ReplicaSupervisor
 
 __all__ = [
@@ -26,10 +27,11 @@ __all__ = [
     "InferenceEngine", "MixedRolloutStepsError", "RolloutOverflowError",
     "ServeMetrics", "PrepPlan", "PrepResult", "SessionPrepCache",
     "QueueFullError", "RequestQueue", "RequestTimeoutError", "ServeFuture",
-    "DispatcherCrashError", "ModelUnavailableError", "Replica", "ReplicaSet",
+    "DispatcherCrashError", "WorkerLostError", "ModelUnavailableError",
+    "Replica", "ReplicaSet", "WorkerQueue", "WorkerReplica",
     "ReplicaSupervisor", "SwapError", "SwapInProgressError",
-    "engine_from_config", "Gateway", "ModelEntry", "ModelRegistry",
-    "PayloadError",
+    "engine_from_config", "engine_with_params_from_config", "Gateway",
+    "ModelEntry", "ModelRegistry", "PayloadError",
 ]
 
 
@@ -76,3 +78,37 @@ def engine_from_config(cfg, model, params, metrics=None):
         result_margin_s=float(s.get("result_margin_s", 30.0)),
         metrics=metrics)
     return engine, q
+
+
+def engine_with_params_from_config(cfg, metrics=None, checkpoint=None):
+    """The registry's full deterministic model+engine+params recipe, shared
+    with the process-worker child (serve/worker.py) so BOTH sides of the
+    IPC boundary hold bitwise-identical params: seeded ``model.init`` on a
+    ladder-padded synthetic graph, then an optional checksummed checkpoint
+    restore. ``checkpoint`` overrides ``cfg.model.checkpoint`` — the worker
+    respawn path after a hot-swap, where the child must come back up on the
+    SWAPPED version, not the config's original. Returns
+    ``(model, engine, queue, params)``; the queue is NOT started."""
+    import jax
+
+    from distegnn_tpu.models.registry import get_model
+
+    model = get_model(cfg.model, dataset_name=cfg.data.dataset_name)
+    metrics = metrics or ServeMetrics()
+    engine, queue = engine_from_config(cfg, model, params=None,
+                                       metrics=metrics)
+    feat_nf = int(cfg.model.node_feat_nf)
+    edge_nf = int(cfg.model.edge_attr_nf)
+    seed = int(cfg.get("seed", 0) or 0)
+    g = synthetic_graph(2, seed=seed, feat_nf=feat_nf, edge_attr_nf=edge_nf)
+    b0 = engine.ladder.bucket_of_graph(g)
+    init_batch, _ = engine.ladder.pad_batch([g], b0, 1,
+                                            **engine._layout_opts)
+    params = model.init(jax.random.PRNGKey(seed), init_batch)
+    ckpt = checkpoint if checkpoint is not None else cfg.model.get("checkpoint")
+    if ckpt:
+        from distegnn_tpu.train.checkpoint import restore_params
+
+        params = restore_params(str(ckpt), params)
+    engine.params = params
+    return model, engine, queue, params

@@ -236,6 +236,25 @@ class InferenceEngine:
         into ``_rollout_opts``."""
         return bool(self._rollout_opts)
 
+    def params_digest(self) -> str:
+        """16-byte blake2b over the flattened param leaves (shape- and
+        dtype-tagged, in canonical tree order) — the cross-process parity
+        fingerprint. The parent compares its own digest against a worker
+        child's at the spawn handshake, so silently divergent init (seed or
+        environment drift) becomes a typed WorkerSpawnError instead of a
+        wrong answer. Both sides build params through
+        ``engine_with_params_from_config``, so the treedefs (and hence the
+        leaf order) match by construction."""
+        import hashlib
+
+        h = hashlib.blake2b(digest_size=16)
+        for leaf in jax.tree_util.tree_leaves(self.params):
+            arr = np.asarray(leaf)
+            h.update(str(arr.shape).encode())
+            h.update(str(arr.dtype).encode())
+            h.update(np.ascontiguousarray(arr).tobytes())
+        return h.hexdigest()
+
     # ---- blue/green canary ----------------------------------------------
     def canary(self, params, buckets: Sequence[Bucket]) -> int:
         """Forward CANDIDATE params through each bucket's compiled

@@ -66,11 +66,17 @@ class ModelEntry:
     def __init__(self, name: str, engine: InferenceEngine,
                  queue: RequestQueue, feat_nf: int, edge_attr_nf: int,
                  config=None, extra_replicas: Sequence = (),
-                 supervisor_opts: Optional[dict] = None):
+                 supervisor_opts: Optional[dict] = None,
+                 replica_objs: Optional[Sequence] = None):
         self.name = name
         self.engine = engine
-        pairs = [(engine, queue)] + list(extra_replicas)
-        self.replicas = ReplicaSet(name, pairs,
+        if replica_objs is not None:
+            # process backend: pre-built WorkerReplica objects; ``engine``
+            # is the parent-side reference handle they all share
+            members = list(replica_objs)
+        else:
+            members = [(engine, queue)] + list(extra_replicas)
+        self.replicas = ReplicaSet(name, members,
                                    supervisor_opts=supervisor_opts)
         self.feat_nf = int(feat_nf)
         self.edge_attr_nf = int(edge_attr_nf)
@@ -105,7 +111,7 @@ class ModelEntry:
                 sizes.append((int(g["loc"].shape[0]),
                               int(g["edge_index"].shape[1])))
             for r in self.replicas.replicas:
-                warmed = r.engine.warmup(sizes)
+                warmed = r.warmup(sizes)
             self.warmed = warmed
             self.state = "ready"
         except Exception as exc:
@@ -155,16 +161,19 @@ class ModelEntry:
             flipped: List = []
             try:
                 for r in self.replicas.replicas:
-                    checked = r.engine.canary(new_params, rungs)
+                    # per-replica blue/green unit: the replica canaries and
+                    # flips its OWN executor (local engine, or the worker
+                    # child over IPC — a down worker defers to its respawn)
+                    checked = r.swap_params(str(checkpoint), new_params,
+                                            rungs)
                     obs.event("gateway/swap_canary", model=self.name,
                               replica=r.idx, rungs=checked)
-                    r.engine.params = new_params
                     flipped.append(r)
                     obs.event("gateway/swap_flip", model=self.name,
                               replica=r.idx)
             except Exception as exc:
                 for r in flipped:
-                    r.engine.params = old_params
+                    r.swap_rollback(old_params)
                 obs.event("gateway/swap_rollback", model=self.name,
                           stage="canary", flipped=len(flipped),
                           error=repr(exc)[:300])
@@ -172,6 +181,10 @@ class ModelEntry:
                     f"swap canary failed for '{self.name}': {exc}; rolled "
                     f"back {len(flipped)} flipped replica(s)",
                     stage="canary", rolled_back=True) from exc
+            # the parent reference handle tracks the live version: it is the
+            # digest source for worker respawns and the params source for
+            # degraded fallbacks (no-op for thread replica 0, same engine)
+            self.engine.params = new_params
             self.checkpoint = str(checkpoint)
             self.params_version += 1
             obs.event("gateway/swap_done", model=self.name,
@@ -246,45 +259,69 @@ class ModelRegistry:
 
     @staticmethod
     def _build_entry(name: str, cfg) -> ModelEntry:
-        import jax
-
-        from distegnn_tpu.models.registry import get_model
-        from distegnn_tpu.serve import engine_from_config
+        from distegnn_tpu.serve import (engine_from_config,
+                                        engine_with_params_from_config)
         from distegnn_tpu.serve.metrics import ServeMetrics
+        from distegnn_tpu.serve.replica import WorkerReplica
 
-        model = get_model(cfg.model, dataset_name=cfg.data.dataset_name)
         n_replicas = max(1, int(cfg.serve.get("replicas", 1) or 1))
+        backend = str(cfg.serve.get("workers", "thread") or "thread")
         metrics = ServeMetrics()  # shared by every replica of this model
-        engine, queue = engine_from_config(cfg, model, params=None,
-                                           metrics=metrics)
+        # the deterministic recipe (seeded init -> optional checksummed
+        # restore) is SHARED with the worker child, which rebuilds params
+        # from the same config — the spawn-handshake digest check pins the
+        # two sides bitwise-identical
+        model, engine, queue, params = engine_with_params_from_config(
+            cfg, metrics=metrics)
         feat_nf = int(cfg.model.node_feat_nf)
         edge_nf = int(cfg.model.edge_attr_nf)
-        seed = int(cfg.get("seed", 0) or 0)
-        g = synthetic_graph(2, seed=seed, feat_nf=feat_nf,
-                            edge_attr_nf=edge_nf)
-        b0 = engine.ladder.bucket_of_graph(g)
-        init_batch, _ = engine.ladder.pad_batch([g], b0, 1,
-                                                **engine._layout_opts)
-        params = model.init(jax.random.PRNGKey(seed), init_batch)
         ckpt = cfg.model.get("checkpoint")
         if ckpt:
-            from distegnn_tpu.train.checkpoint import restore_params
-
-            params = restore_params(ckpt, params)
             obs.event("gateway/params_restored", model=name, path=str(ckpt))
-        engine.params = params
-        extra = []
-        for _ in range(n_replicas - 1):
-            eng_i, q_i = engine_from_config(cfg, model, params=params,
-                                            metrics=metrics)
-            # the prep-plan cache is engine-agnostic (pure layout plans):
-            # share it so a failed-over session keeps its prep hit rate
-            eng_i.prep_cache = engine.prep_cache
-            extra.append((eng_i, q_i))
-        entry = ModelEntry(name, engine, queue, feat_nf, edge_nf, config=cfg,
-                           extra_replicas=extra,
-                           supervisor_opts=dict(cfg.serve.get("supervisor")
-                                                or {}))
+        supervisor_opts = dict(cfg.serve.get("supervisor") or {})
+        if backend == "process":
+            s = cfg.serve
+            queue_kw = dict(
+                batch_deadline_ms=s.batch_deadline_ms,
+                queue_capacity=s.queue_capacity,
+                request_timeout_ms=s.request_timeout_ms,
+                result_margin_s=float(s.get("result_margin_s", 30.0)),
+                metrics=metrics)
+            cfg_dict = copy.deepcopy(cfg.to_dict())
+            worker_opts = dict(cfg.serve.get("worker") or {})
+
+            def fallback_factory(_cfg=cfg, _model=model, _engine=engine,
+                                 _metrics=metrics):
+                # spawn-failure degradation: a fresh in-process pair serving
+                # the parent handle's CURRENT params (post-swap correct),
+                # sharing the prep cache so sessions keep their hit rate
+                eng_i, q_i = engine_from_config(_cfg, _model,
+                                                params=_engine.params,
+                                                metrics=_metrics)
+                eng_i.prep_cache = _engine.prep_cache
+                return eng_i, q_i
+
+            replica_objs = [
+                WorkerReplica(i, engine, model=name, queue_kw=queue_kw,
+                              worker_opts=worker_opts, cfg_dict=cfg_dict,
+                              fallback_factory=fallback_factory,
+                              checkpoint=(str(ckpt) if ckpt else None))
+                for i in range(n_replicas)]
+            entry = ModelEntry(name, engine, None, feat_nf, edge_nf,
+                               config=cfg, replica_objs=replica_objs,
+                               supervisor_opts=supervisor_opts)
+        else:
+            extra = []
+            for _ in range(n_replicas - 1):
+                eng_i, q_i = engine_from_config(cfg, model, params=params,
+                                                metrics=metrics)
+                # the prep-plan cache is engine-agnostic (pure layout plans):
+                # share it so a failed-over session keeps its prep hit rate
+                eng_i.prep_cache = engine.prep_cache
+                extra.append((eng_i, q_i))
+            entry = ModelEntry(name, engine, queue, feat_nf, edge_nf,
+                               config=cfg, extra_replicas=extra,
+                               supervisor_opts=supervisor_opts)
         if ckpt:
             entry.checkpoint = str(ckpt)
         return entry
@@ -334,6 +371,12 @@ class ModelRegistry:
         queue can't consume every other model's drain window."""
         budget = 30.0 if grace_s is None else max(float(grace_s), 0.1)
         entries = self.items()
+        # phase 1 for EVERY model before any drain: stop the supervisors so
+        # an in-flight restart can't revive a queue / spawn a worker after
+        # its drain begins (the supervisor also rechecks _supervised after
+        # any blocking claim, covering a restart already past the flag)
+        for _, e in entries:
+            e.replicas.begin_stop()
         if len(entries) == 1:
             entries[0][1].stop(drain=drain, join_timeout_s=budget)
             return
@@ -371,6 +414,17 @@ class ModelRegistry:
                 "error": e.error,
                 "replicas_available": e.replicas.available(),
                 "replicas_total": len(e.replicas.replicas),
+                # per-worker detail (pid/heartbeat for process backends;
+                # threads report backend only) — /readyz surfaces this and
+                # /metrics derives the heartbeat-age gauges from it
+                "workers": [
+                    {"replica": h["replica"],
+                     "backend": h.get("backend", "thread"),
+                     "pid": h.get("pid"),
+                     "heartbeat_age_s": h.get("heartbeat_age_s"),
+                     "restarts": h["restarts"],
+                     "degraded": h.get("degraded", False)}
+                    for h in e.replicas.health()],
             }
         return out
 

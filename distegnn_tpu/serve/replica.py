@@ -32,13 +32,17 @@ first-wins resolution drops any late result from an abandoned replica.
 
 from __future__ import annotations
 
+import os
 import threading
 import time
 from typing import Dict, List, Optional, Set
 
 from distegnn_tpu import obs
+from distegnn_tpu.serve import worker as worker_mod
+from distegnn_tpu.serve.buckets import Bucket
 from distegnn_tpu.serve.queue import (DispatcherCrashError, RequestQueue,
-                                      ServeFuture)
+                                      ServeFuture, WorkerLostError,
+                                      _request_ids)
 
 
 class ModelUnavailableError(RuntimeError):
@@ -84,6 +88,8 @@ class Replica:
     (crashed/wedged, restart scheduled) → ``broken`` (circuit breaker open,
     long cooldown) → ``running`` again, or → ``stopped`` (clean shutdown).
     """
+
+    backend = "thread"
 
     def __init__(self, idx: int, engine, queue: RequestQueue):
         self.idx = idx
@@ -135,6 +141,360 @@ class Replica:
             metrics=old.metrics)
         return self.queue
 
+    # ---- backend lifecycle (WorkerReplica overrides) ---------------------
+    def start_queue(self) -> None:
+        """Start the current queue (ReplicaSet.start / supervisor restart).
+        WorkerReplica's override spawns the child and degrades to an
+        in-process queue on spawn failure."""
+        self.queue.start()
+
+    def restart_queue(self) -> None:
+        """Supervisor restart: fresh queue (fresh worker for the process
+        backend), then start it."""
+        self.fresh_queue()
+        self.start_queue()
+
+    def warmup(self, sizes) -> List[Bucket]:
+        """Warm this replica's EXECUTOR — the local engine here, the worker
+        child for the process backend."""
+        return self.engine.warmup(sizes)
+
+    def swap_params(self, checkpoint: str, new_params, rungs) -> int:
+        """Blue/green unit, one replica: canary CANDIDATE params on this
+        replica's executor, then flip atomically. Returns rungs checked;
+        raises (CanaryError, ...) without flipping on failure."""
+        checked = self.engine.canary(new_params, rungs)
+        self.engine.params = new_params
+        return checked
+
+    def swap_rollback(self, old_params) -> None:
+        """Undo a flip this swap already applied to this replica."""
+        self.engine.params = old_params
+
+    def backend_detail(self) -> dict:
+        """Extra per-replica health fields (pid/heartbeat/degraded for the
+        process backend; empty for threads)."""
+        return {}
+
+
+def _obs_run_dir() -> Optional[str]:
+    """Directory of the live obs sink (``<run>/obs``) — worker children put
+    their stderr logs and per-process event files next to the parent's
+    events.jsonl. None when tracing is off (worker stderr then lands in a
+    tempdir so it is never lost)."""
+    try:
+        from distegnn_tpu.obs.trace import get_tracer
+
+        w = get_tracer().writer
+        if w is not None and getattr(w, "path", None):
+            return os.path.dirname(os.path.abspath(str(w.path)))
+    except Exception:
+        pass
+    return None
+
+
+class WorkerQueue(RequestQueue):
+    """RequestQueue whose micro-batches execute in an out-of-process worker
+    child over the checksummed IPC channel (serve/worker.py).
+
+    Inherits ALL of the parent-side machinery — bounded ingress, per-bucket
+    coalescing, deadlines, poison retry, kill/wedge chaos, crash budget —
+    and overrides only the batch-execution hop: ``_run_batch`` becomes one
+    framed call with a hard deadline, and a dead channel surfaces as
+    :class:`~distegnn_tpu.serve.queue.WorkerLostError` so the dispatcher
+    poisons itself and the replica layer fails the work over. The
+    parent-side ``engine`` stays the model's reference handle (ladder math,
+    prep cache, params for digest/fallback); it never executes this queue's
+    traffic.
+    """
+
+    backend = "process"
+
+    def __init__(self, engine, *, spawn_fn, model: str = "default",
+                 idx: int = 0, kill_grace_s: float = 3.0, **queue_kw):
+        super().__init__(engine, **queue_kw)
+        self._spawn_fn = spawn_fn  # () -> WorkerHandle; may raise WorkerSpawnError
+        self.model = model
+        self.idx = idx
+        self.kill_grace_s = float(kill_grace_s)
+        self.worker: Optional[worker_mod.WorkerHandle] = None
+
+    def start(self):
+        if self.worker is None:
+            self.worker = self._spawn_fn()  # WorkerSpawnError propagates
+        return super().start()
+
+    def alive(self) -> bool:
+        w = self.worker
+        return (super().alive() and w is not None
+                and w.lost_reason is None and w.proc_alive())
+
+    def heartbeat_age(self) -> Optional[float]:
+        """Seconds since the child's last frame (the supervisor's staleness
+        wedge signal); None before the worker exists."""
+        w = self.worker
+        return None if w is None else w.heartbeat_age()
+
+    @property
+    def pid(self) -> Optional[int]:
+        w = self.worker
+        return None if w is None else w.pid
+
+    def _run_batch(self, key, reqs) -> List:
+        kind, bucket, _steps = key
+        w = self.worker
+        if w is None:
+            raise WorkerLostError(
+                f"worker {self.model}/{self.idx} never spawned")
+        rids = _request_ids(reqs)
+        timeout = self.request_timeout + self.result_margin
+        try:
+            if kind == "rollout":
+                return w.call("rollout",
+                              {"scenes": [r.graph for r in reqs],
+                               "request_ids": rids}, timeout_s=timeout)
+            return w.call("predict",
+                          {"graphs": [r.graph for r in reqs],
+                           "bucket": list(bucket) if bucket else None,
+                           "request_ids": rids}, timeout_s=timeout)
+        except (worker_mod.WorkerClosedError,
+                worker_mod.WorkerTimeoutError) as exc:
+            raise WorkerLostError(
+                f"worker {self.model}/{self.idx} (pid {self.pid}) lost "
+                f"mid-batch: {exc}") from exc
+
+    def kill(self, reason: str = "killed") -> None:
+        super().kill(reason)
+        self.ensure_worker_dead()
+
+    def ensure_worker_dead(self) -> None:
+        """SIGTERM → SIGKILL the child and reap the zombie (idempotent)."""
+        w = self.worker
+        if w is not None:
+            w.terminate(grace_s=self.kill_grace_s)
+
+    def stop(self, drain: bool = True, join_timeout_s: float = 30.0) -> None:
+        super().stop(drain=drain, join_timeout_s=join_timeout_s)
+        w = self.worker
+        if w is not None and w.lost_reason is None and w.proc_alive():
+            try:
+                # polite shutdown flushes the child's obs buffers
+                w.call("shutdown", timeout_s=min(float(join_timeout_s), 5.0))
+            except worker_mod.WorkerError:
+                pass
+        self.ensure_worker_dead()
+
+
+class WorkerReplica(Replica):
+    """Replica whose dispatcher queue executes in a worker child process
+    (``serve.workers: process``).
+
+    The ``engine`` attribute stays the PARENT-side reference handle: it
+    holds the canonical params (digest source for the spawn handshake,
+    fallback source for degradation) and the shared prep/session caches,
+    but never runs this replica's traffic. In-flight tracking lives in the
+    base class — in the parent — which is what makes at-most-once failover
+    survive a SIGKILL'd child.
+
+    Degradation: a spawn failure (exec error, init crash, digest mismatch)
+    falls back to a fresh in-process queue with a ``gateway/worker_degraded``
+    event — the model keeps serving without isolation, and the next
+    supervised restart attempts a real worker again.
+    """
+
+    backend = "process"
+
+    def __init__(self, idx: int, engine, *, model: str, queue_kw: dict,
+                 worker_opts: dict, cfg_dict: dict, fallback_factory,
+                 checkpoint: Optional[str] = None):
+        super().__init__(idx, engine, None)
+        self.model_name = model
+        self.degraded = False
+        self.current_checkpoint = checkpoint  # tracks swaps for respawn
+        self.warm_sizes: List = []
+        self._queue_kw = dict(queue_kw)
+        self._worker_opts = dict(worker_opts or {})
+        self._cfg_dict = cfg_dict
+        self._fallback_factory = fallback_factory
+        self._spawn_fail_next = 0  # chaos: forced spawn failures
+        self._swap_prev_ckpt: Optional[str] = None
+        # orders deferred-swap bookkeeping against the post-spawn catch-up
+        # check in start_queue (a swap can defer WHILE a respawn is in
+        # flight; whichever side runs second must see the other's write)
+        self._ckpt_lock = threading.Lock()
+        self.queue = self._make_worker_queue()
+
+    # ---- spawn -----------------------------------------------------------
+    def _make_worker_queue(self) -> WorkerQueue:
+        return WorkerQueue(
+            self.engine, spawn_fn=self._spawn_worker, model=self.model_name,
+            idx=self.idx,
+            kill_grace_s=float(self._worker_opts.get("kill_grace_s", 3.0)),
+            **self._queue_kw)
+
+    def _spawn_worker(self) -> worker_mod.WorkerHandle:
+        if self._spawn_fail_next > 0:
+            self._spawn_fail_next -= 1
+            raise worker_mod.WorkerSpawnError(
+                f"injected spawn failure (chaos) for "
+                f"{self.model_name}/{self.idx}")
+        opts = self._worker_opts
+        return worker_mod.WorkerHandle.spawn(
+            self._cfg_dict, self.model_name, self.idx,
+            checkpoint=self.current_checkpoint,
+            warm_sizes=list(self.warm_sizes),
+            obs_dir=_obs_run_dir(),
+            spawn_timeout_s=float(opts.get("spawn_timeout_s", 120.0)),
+            heartbeat_s=float(opts.get("heartbeat_s", 0.5)),
+            kill_grace_s=float(opts.get("kill_grace_s", 3.0)),
+            expect_digest=self.engine.params_digest(),
+            matmul_precision=worker_mod.current_matmul_precision())
+
+    def fail_next_spawns(self, n: int = 1) -> None:
+        """Chaos hook (testing/serve_faults.py): the next ``n`` spawn
+        attempts raise WorkerSpawnError, exercising degradation."""
+        self._spawn_fail_next = int(n)
+
+    # ---- lifecycle -------------------------------------------------------
+    def start_queue(self) -> None:
+        try:
+            self.queue.start()
+            if isinstance(self.queue, WorkerQueue):
+                self.degraded = False
+                self._catch_up_checkpoint()
+        except worker_mod.WorkerSpawnError as exc:
+            obs.event("gateway/worker_degraded", model=self.model_name,
+                      replica=self.idx, error=str(exc)[:300])
+            if isinstance(self.queue, WorkerQueue):
+                # a failed catch-up swap leaves a RUNNING queue over a
+                # stale child: poison the dispatcher and kill the child
+                self.queue.kill(reason="stale-checkpoint catch-up failed")
+            _eng, q = self._fallback_factory()
+            self.queue = q
+            self.queue.start()
+            self.degraded = True
+
+    def _catch_up_checkpoint(self) -> None:
+        """Close the in-flight-spawn swap window: a spawn takes seconds
+        (child jax import), and a hot-swap that arrives in that window
+        defers — but the child already captured the PRE-swap checkpoint,
+        so without this it would come up serving stale params and the
+        deferral would never reach it. Compare what the child actually
+        loaded against ``current_checkpoint`` under the same lock the
+        deferred branch writes it, and swap the fresh worker over IPC if
+        they diverge. A failure here is a spawn failure (the child is
+        unusable on the wrong version) → WorkerSpawnError → degradation,
+        whose fallback serves the parent handle's post-swap params."""
+        w = self.queue.worker
+        if w is None:
+            return
+        with self._ckpt_lock:
+            want = self.current_checkpoint
+            if not want or getattr(w, "checkpoint", None) == want:
+                return
+            try:
+                w.call("swap", {"checkpoint": want, "rungs": []},
+                       timeout_s=float(
+                           self._worker_opts.get("spawn_timeout_s", 120.0)))
+            except worker_mod.WorkerError as exc:
+                raise worker_mod.WorkerSpawnError(
+                    f"worker {self.model_name}/{self.idx} spawned on a "
+                    f"stale checkpoint and the catch-up swap to {want!r} "
+                    f"failed: {exc}") from exc
+            w.checkpoint = want
+            obs.event("gateway/swap_catchup", model=self.model_name,
+                      replica=self.idx, path=want)
+
+    def reconcile_checkpoint(self) -> None:
+        """Supervisor-tick safety net for the last swap/respawn race window
+        (a deferral landing between the post-spawn catch-up check and the
+        replica being marked up): if a healthy worker is serving a version
+        other than ``current_checkpoint``, catch it up now; if that fails,
+        kill the queue so the normal restart path reloads the right
+        version. Normal ticks cost one attribute compare."""
+        if self.degraded or not isinstance(self.queue, WorkerQueue):
+            return
+        w = self.queue.worker
+        if (w is None or not self.current_checkpoint
+                or getattr(w, "checkpoint", None) == self.current_checkpoint):
+            return
+        try:
+            self._catch_up_checkpoint()
+        except worker_mod.WorkerSpawnError:
+            self.queue.kill(reason="checkpoint reconcile failed")
+
+    def fresh_queue(self) -> WorkerQueue:
+        old = self.queue
+        if isinstance(old, WorkerQueue):
+            old.ensure_worker_dead()
+        # ALWAYS retry the worker backend, even off a degraded fallback:
+        # degradation is temporary by construction
+        self.queue = self._make_worker_queue()
+        return self.queue
+
+    def warmup(self, sizes) -> List[Bucket]:
+        self.warm_sizes = [tuple(s) for s in sizes]
+        if not isinstance(self.queue, WorkerQueue):
+            return self.queue.engine.warmup(sizes)
+        w = self.queue.worker
+        if w is None:
+            raise RuntimeError(
+                f"worker {self.model_name}/{self.idx} not spawned — start "
+                f"the replica set before warmup")
+        rungs = w.call(
+            "warmup", {"sizes": [list(s) for s in self.warm_sizes]},
+            timeout_s=float(self._worker_opts.get("spawn_timeout_s", 120.0)))
+        return [Bucket(*r) for r in rungs]
+
+    # ---- blue/green ------------------------------------------------------
+    def swap_params(self, checkpoint: str, new_params, rungs) -> int:
+        self._swap_prev_ckpt = self.current_checkpoint
+        if not isinstance(self.queue, WorkerQueue):
+            # degraded fallback executes in-process: flip its engine
+            checked = self.queue.engine.canary(new_params, rungs)
+            self.queue.engine.params = new_params
+            self.current_checkpoint = str(checkpoint)
+            return checked
+        w = self.queue.worker
+        if w is None or not self.healthy():
+            # down / mid-restart: adopt the new version at the next respawn
+            # instead of failing the whole swap. Under _ckpt_lock so a
+            # respawn already past its catch-up check can't miss this write
+            # (the catch-up re-reads current_checkpoint under the same lock).
+            with self._ckpt_lock:
+                self.current_checkpoint = str(checkpoint)
+            obs.event("gateway/swap_deferred", model=self.model_name,
+                      replica=self.idx, path=str(checkpoint))
+            return 0
+        res = w.call(
+            "swap", {"checkpoint": str(checkpoint),
+                     "rungs": [[b.n, b.e] for b in rungs]},
+            timeout_s=float(self._worker_opts.get("spawn_timeout_s", 120.0)))
+        self.current_checkpoint = str(checkpoint)
+        return int(res.get("rungs", 0))
+
+    def swap_rollback(self, old_params) -> None:
+        self.current_checkpoint = self._swap_prev_ckpt
+        if not isinstance(self.queue, WorkerQueue):
+            self.queue.engine.params = old_params
+            return
+        w = self.queue.worker
+        if w is not None and self.healthy():
+            try:
+                w.call("swap_rollback", timeout_s=30.0)
+            except worker_mod.WorkerError:
+                pass  # child is dying; its respawn loads _swap_prev_ckpt
+
+    # ---- health ----------------------------------------------------------
+    def backend_detail(self) -> dict:
+        q = self.queue
+        if self.degraded or not isinstance(q, WorkerQueue):
+            return {"backend": "thread", "degraded": self.degraded,
+                    "pid": None, "heartbeat_age_s": None}
+        age = q.heartbeat_age()
+        return {"backend": "process", "degraded": False, "pid": q.pid,
+                "heartbeat_age_s": None if age is None else round(age, 3)}
+
 
 class ReplicaSet:
     """N shared-nothing replicas of one model behind one admission front.
@@ -148,7 +508,16 @@ class ReplicaSet:
         if not pairs:
             raise ValueError("ReplicaSet needs at least one (engine, queue)")
         self.model = model
-        self.replicas = [Replica(i, eng, q) for i, (eng, q) in enumerate(pairs)]
+        # members are (engine, queue) pairs or pre-built Replica objects
+        # (the registry hands in WorkerReplicas for the process backend)
+        self.replicas: List[Replica] = []
+        for i, item in enumerate(pairs):
+            if isinstance(item, Replica):
+                item.idx = i
+                self.replicas.append(item)
+            else:
+                eng, q = item
+                self.replicas.append(Replica(i, eng, q))
         self.metrics = self.replicas[0].queue.metrics
         self.request_timeout = self.replicas[0].queue.request_timeout
         self.result_margin = self.replicas[0].queue.result_margin
@@ -172,16 +541,25 @@ class ReplicaSet:
     def start(self) -> "ReplicaSet":
         now = time.perf_counter()
         for r in self.replicas:
-            r.queue.start()
+            r.start_queue()
             r.state = "running"
             r.started_at = now
         self._supervised = True
         self.supervisor.start()
         return self
 
-    def stop(self, drain: bool = True, join_timeout_s: float = 30.0) -> None:
+    def begin_stop(self) -> None:
+        """Phase 1 of shutdown: drop the supervised flag and stop the
+        supervisor BEFORE any queue drains, so an in-flight restart can
+        never revive a queue (or spawn a worker) after drain has begun —
+        the supervisor's _restart rechecks ``_supervised`` after its
+        blocking claim and aborts. Idempotent; ModelRegistry.stop calls it
+        for EVERY model before draining any of them."""
         self._supervised = False
         self.supervisor.stop()
+
+    def stop(self, drain: bool = True, join_timeout_s: float = 30.0) -> None:
+        self.begin_stop()
         for r in self.replicas:
             r.queue.stop(drain=drain, join_timeout_s=join_timeout_s)
             r.state = "stopped"
@@ -287,11 +665,16 @@ class ReplicaSet:
         return sum(1 for r in self.replicas if r.healthy())
 
     def health(self) -> List[dict]:
-        return [{"replica": r.idx, "state": r.state,
-                 "alive": r.queue.alive(), "failures": r.failures,
-                 "restarts": r.restarts, "inflight": r.inflight_count(),
-                 "depth": r.queue.depth(), "last_reason": r.last_reason}
-                for r in self.replicas]
+        rows = []
+        for r in self.replicas:
+            row = {"replica": r.idx, "state": r.state,
+                   "alive": r.queue.alive(), "failures": r.failures,
+                   "restarts": r.restarts, "inflight": r.inflight_count(),
+                   "depth": r.queue.depth(), "last_reason": r.last_reason,
+                   "backend": r.backend}
+            row.update(r.backend_detail())  # may downgrade backend: degraded
+            rows.append(row)
+        return rows
 
     def retry_after_s(self) -> float:
         """Hint for 503 Retry-After: time to the earliest scheduled replica

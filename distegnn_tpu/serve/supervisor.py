@@ -23,6 +23,21 @@ every ``heartbeat_s`` and drives each replica's state machine:
     A replica that stays healthy for ``healthy_reset_s`` gets its failure
     count cleared (breaker closes).
 
+Process-backed replicas (``serve.workers: process``) run under the SAME
+state machine with two additions: liveness also covers the child process
+(``WorkerQueue.alive()`` folds in a process poll, so a SIGKILL'd child is
+a plain **crash**), and a second wedge signal — heartbeat staleness. A
+SIGSTOPped or truly GIL-wedged child stops beating even when the queue is
+idle, which ``depth() > 0`` progress tracking can never see; when
+``heartbeat_age()`` (duck-typed, None for thread replicas) exceeds
+``worker_heartbeat_timeout_s`` the replica is marked down as a wedge.
+Every mark-down of a process replica kills its queue, which escalates
+SIGTERM → SIGKILL with zombie reaping (``WorkerQueue.kill``) — SIGKILL is
+what actually fells a stopped child. The respawn path then goes through
+the same backoff/breaker math; a spawn failure degrades to an in-process
+queue (``gateway/worker_degraded``) inside ``restart_queue`` rather than
+shedding the model.
+
 Every transition emits a ``gateway/replica_*`` obs event. ``tick()`` is
 public so tests drive the state machine deterministically with synthetic
 clocks instead of sleeping through real heartbeats.
@@ -45,6 +60,7 @@ class ReplicaSupervisor:
     def __init__(self, replica_set, *,
                  heartbeat_s: float = 0.25,
                  wedge_timeout_s: float = 60.0,
+                 worker_heartbeat_timeout_s: float = 10.0,
                  backoff_base_s: float = 0.5,
                  backoff_max_s: float = 30.0,
                  breaker_threshold: int = 3,
@@ -53,6 +69,7 @@ class ReplicaSupervisor:
         self.rset = replica_set
         self.heartbeat_s = float(heartbeat_s)
         self.wedge_timeout_s = float(wedge_timeout_s)
+        self.worker_heartbeat_timeout_s = float(worker_heartbeat_timeout_s)
         self.backoff_base_s = float(backoff_base_s)
         self.backoff_max_s = float(backoff_max_s)
         self.breaker_threshold = int(breaker_threshold)
@@ -96,6 +113,8 @@ class ReplicaSupervisor:
             if r.state == "running":
                 if not r.queue.alive():
                     self._mark_down(r, "crash", now)
+                elif self._heartbeat_stale(r):
+                    self._mark_down(r, "wedge", now)
                 elif (r.queue.depth() > 0
                       and now - r.queue.last_progress > self.wedge_timeout_s):
                     self._mark_down(r, "wedge", now)
@@ -103,9 +122,26 @@ class ReplicaSupervisor:
                     r.failures = 0
                     obs.event("gateway/replica_breaker_close",
                               model=self.rset.model, replica=r.idx)
+                if r.state == "running":
+                    # process replicas: heal a worker left on a stale
+                    # checkpoint by a swap that raced its respawn
+                    rec = getattr(r, "reconcile_checkpoint", None)
+                    if callable(rec):
+                        rec()
             elif r.state in ("backoff", "broken"):
                 if now >= r.next_restart_at:
                     self._restart(r, now)
+
+    def _heartbeat_stale(self, r) -> bool:
+        """True when a process-backed replica's child has stopped beating
+        (SIGSTOP / hard GIL wedge). Duck-typed: thread queues have no
+        heartbeat_age and return None here. Ages are real ``monotonic``
+        seconds — synthetic test clocks don't apply to this signal."""
+        fn = getattr(r.queue, "heartbeat_age", None)
+        if not callable(fn):
+            return False
+        age = fn()
+        return age is not None and age > self.worker_heartbeat_timeout_s
 
     def _mark_down(self, r, reason: str, now: float) -> None:
         r.last_reason = reason
@@ -128,21 +164,32 @@ class ReplicaSupervisor:
         # per-request gateway/replica_failover events carry the detail —
         # obs.log would pollute stdout-contract scripts (traffic_gen)
         self.rset.fail_over_replica(r, reason=reason)
-        if reason == "wedge":
-            r.queue.kill(reason=f"wedged: no batch progress in "
-                                f"{self.wedge_timeout_s:.1f} s "
-                                f"(abandoned by supervisor)")
+        if reason == "wedge" or getattr(r.queue, "backend", "thread") == "process":
+            # wedge: poison stragglers so no future hangs. Process backend:
+            # ALWAYS kill — WorkerQueue.kill escalates SIGTERM → SIGKILL
+            # (the only signal a SIGSTOPped child honors) and reaps the
+            # zombie, so a dead child never lingers between restarts.
+            r.queue.kill(reason=f"marked down ({reason}) by supervisor")
 
     def _restart(self, r, now: float) -> None:
         r.restarts += 1
         self.rset.metrics.replica_restarted()
         try:
-            r.fresh_queue().start()
+            r.restart_queue()
         except Exception as exc:
             # counts as another failure: breaker math applies unchanged
             obs.log(f"serve: {self.rset.model} replica {r.idx} restart "
                     f"failed: {exc!r}")
             self._mark_down(r, "restart_failed", now)
+            return
+        if not self.rset._supervised:
+            # a stop() raced us while restart_queue was blocked (a worker
+            # spawn can take seconds): never revive a queue after drain
+            # has begun
+            r.queue.stop(drain=False, join_timeout_s=2.0)
+            r.state = "stopped"
+            obs.event("gateway/replica_restart_aborted",
+                      model=self.rset.model, replica=r.idx)
             return
         r.state = "running"
         r.started_at = now

@@ -679,6 +679,17 @@ class Gateway:
                 up = 1.0 if (rh["state"] == "running" and rh["alive"]) else 0.0
                 self._reg.gauge(
                     f"gateway/replica_{name}_{rh['replica']}_up").set(up)
+                if rh.get("backend") == "process":
+                    # per-worker liveness detail: a climbing heartbeat age
+                    # is the early-warning signal for a wedging child
+                    age = rh.get("heartbeat_age_s")
+                    self._reg.gauge(
+                        f"gateway/worker_{name}_{rh['replica']}_"
+                        f"heartbeat_age_s").set(
+                            float(age) if age is not None else -1.0)
+                    self._reg.gauge(
+                        f"gateway/worker_{name}_{rh['replica']}_"
+                        f"restarts").set(float(rh.get("restarts", 0)))
             self._reg.gauge(f"gateway/replicas_{name}_available").set(
                 entry.replicas.available())
         parts = [self._reg.render_prometheus(prefix="distegnn")]

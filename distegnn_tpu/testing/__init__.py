@@ -17,7 +17,10 @@ from distegnn_tpu.testing.faults import (
 from distegnn_tpu.testing.serve_faults import (
     corrupt_swap_checkpoint,
     inject_execute_latency,
+    kill9_replica,
     kill_replica,
+    sigstop_replica,
+    spawn_failure,
     wedge_replica,
 )
 
@@ -28,6 +31,9 @@ __all__ = [
     "flaky_open",
     "inject_at_call",
     "kill_replica",
+    "kill9_replica",
+    "sigstop_replica",
+    "spawn_failure",
     "wedge_replica",
     "inject_execute_latency",
     "corrupt_swap_checkpoint",

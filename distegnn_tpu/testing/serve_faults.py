@@ -21,11 +21,29 @@ replica pool), so tests/test_replica.py and ``scripts/traffic_gen.py
     swap must fail at the restore stage and roll back, never flipping a
     replica onto garbage params.
 
+Process-level injectors (``serve.workers: process`` only — they target the
+replica's worker CHILD, proving the process-isolation story end to end):
+
+  - :func:`kill9_replica` — SIGKILL the child outright (the OOM killer, a
+    segfaulting extension). The parent-side in-flight tracking must fail
+    the work over to survivors; the supervisor respawns through backoff.
+  - :func:`sigstop_replica` — freeze the child without killing it (a
+    debugger attach, cgroup freezer, swap storm). Queue progress tracking
+    can't see this when idle; heartbeat staleness must catch it and the
+    supervisor must escalate to SIGKILL (the only signal a stopped
+    process honors) before respawning.
+  - :func:`spawn_failure` — arm the NEXT ``n`` respawn attempts to fail
+    (exec failure, bad image, broken env). The replica must degrade to an
+    in-process queue (``gateway/worker_degraded``) instead of shedding.
+
 All injectors are process-local: they need the registry object, not a URL
 (``traffic_gen --chaos`` therefore refuses to run against ``--url``).
 """
 
 from __future__ import annotations
+
+import os
+import signal
 
 from distegnn_tpu.testing.faults import corrupt_checkpoint
 
@@ -68,6 +86,54 @@ def inject_execute_latency(registry, model: str, seconds: float,
                else [_replica(registry, model, replica)])
     for r in targets:
         r.queue.inject_latency(float(seconds))
+
+
+def _worker_pid(r, model: str, replica: int, what: str) -> int:
+    pid = getattr(r.queue, "pid", None)
+    if pid is None:
+        raise ValueError(
+            f"{what} targets a worker child, but {model!r} replica "
+            f"{replica} has no live worker process (thread backend or "
+            f"degraded) — run under serve.workers: process")
+    return int(pid)
+
+
+def kill9_replica(registry, model: str, replica: int = 0) -> int:
+    """SIGKILL one replica's worker child (the OOM killer's signature move).
+    No cleanup runs in the child; the parent's reader thread sees EOF, fails
+    in-flight work over to survivors, and the supervisor respawns the child
+    behind backoff. Returns the pid killed."""
+    r = _replica(registry, model, replica)
+    pid = _worker_pid(r, model, replica, "kill9")
+    os.kill(pid, signal.SIGKILL)
+    return pid
+
+
+def sigstop_replica(registry, model: str, replica: int = 0) -> int:
+    """SIGSTOP one replica's worker child: the process stays alive but stops
+    beating. Heartbeat staleness (``worker_heartbeat_timeout_s``) must mark
+    it wedged; the supervisor's kill escalates SIGTERM → SIGKILL, which a
+    stopped process does honor. Returns the pid stopped."""
+    r = _replica(registry, model, replica)
+    pid = _worker_pid(r, model, replica, "sigstop")
+    os.kill(pid, signal.SIGSTOP)
+    return pid
+
+
+def spawn_failure(registry, model: str, n: int = 1,
+                  replica: int = 0) -> None:
+    """Arm the next ``n`` worker spawn attempts on one replica to fail
+    (injected WorkerSpawnError). Combined with :func:`kill9_replica` this
+    proves graceful degradation: the respawn fails, the replica falls back
+    to an in-process queue with ``gateway/worker_degraded``, and the NEXT
+    restart retries the worker backend."""
+    r = _replica(registry, model, replica)
+    fn = getattr(r, "fail_next_spawns", None)
+    if fn is None:
+        raise ValueError(
+            f"spawn_failure needs a process-backed replica; {model!r} "
+            f"replica {replica} is thread-backed")
+    fn(int(n))
 
 
 def corrupt_swap_checkpoint(path: str, mode: str = "garbage") -> None:

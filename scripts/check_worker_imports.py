@@ -1,0 +1,126 @@
+#!/usr/bin/env python
+"""Lint: the serving worker child stays import-isolated.
+
+``distegnn_tpu/serve/worker.py`` runs inside every worker child and is the
+one module the parent's supervision stack must be able to trust blindly:
+
+  - Its MODULE-LEVEL imports must be stdlib-only. The child's argparse /
+    framing / signal plumbing has to come up even when jax or the model
+    zoo is broken — a child that dies during ``import worker`` can't
+    report the failure over the IPC channel, it just looks like a spawn
+    timeout. Heavy imports (jax, the engine, obs) happen lazily inside
+    the init handshake, where a failure is caught and sent back typed.
+  - It must NEVER import the parent-side serving stack —
+    ``serve.transport``, ``serve.registry``, ``serve.supervisor`` — at
+    any level. The worker is the LEAF of the supervision tree; a child
+    that could instantiate a registry or supervisor could recursively
+    spawn workers, and a transport import would drag the HTTP stack into
+    every child. The allowed surface is the engine side only
+    (``serve.buckets``, ``serve.engine``, ``engine_with_params_from_config``).
+
+Checked with ast (no regex false-positives on strings/comments), covering
+lazy in-function imports too. Wired into tier-1 via
+tests/test_worker.py::test_worker_import_isolation. Exit codes: 0 clean,
+1 violations (one ``path:line: reason`` per offense).
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+WORKER = os.path.join(REPO, "distegnn_tpu", "serve", "worker.py")
+
+# parent-side supervision stack: banned at ANY import depth in the child
+_BANNED_MODULES = (
+    "distegnn_tpu.serve.transport",
+    "distegnn_tpu.serve.registry",
+    "distegnn_tpu.serve.supervisor",
+)
+# lazy re-exports on the serve package namespace that resolve to the same
+# banned modules: `from distegnn_tpu.serve import ModelRegistry` is the
+# registry import wearing a different hat
+_BANNED_SERVE_ATTRS = frozenset({
+    "Gateway", "ModelRegistry", "ModelEntry", "ReplicaSupervisor",
+    "ReplicaSet", "Replica", "WorkerReplica", "WorkerQueue",
+})
+
+
+def _stdlib_names() -> frozenset:
+    names = getattr(sys, "stdlib_module_names", None)
+    if names is None:  # < 3.10: close enough for the modules worker.py uses
+        names = {"argparse", "atexit", "base64", "collections", "contextlib",
+                 "dataclasses", "functools", "io", "itertools", "json",
+                 "logging", "math", "os", "pickle", "re", "signal", "socket",
+                 "struct", "subprocess", "sys", "tempfile", "threading",
+                 "time", "traceback", "types", "typing", "zlib",
+                 "__future__"}
+    return frozenset(names)
+
+
+def _imported_modules(node):
+    """Module names an Import/ImportFrom pulls in (ImportFrom -> the module;
+    Import -> each dotted name)."""
+    if isinstance(node, ast.Import):
+        return [a.name for a in node.names]
+    if isinstance(node, ast.ImportFrom):
+        return [node.module or ""]
+    return []
+
+
+def find_violations(path: str = WORKER):
+    """[(lineno, reason)] for every import-isolation breach in the file."""
+    with open(path, encoding="utf-8") as f:
+        tree = ast.parse(f.read(), filename=path)
+    stdlib = _stdlib_names()
+    out = []
+
+    # 1) module level: stdlib only
+    for node in tree.body:
+        if not isinstance(node, (ast.Import, ast.ImportFrom)):
+            continue
+        for mod in _imported_modules(node):
+            top = mod.split(".")[0]
+            if top not in stdlib:
+                out.append((node.lineno,
+                            f"module-level import of {mod!r} is not stdlib "
+                            "— the child must come up without it; import "
+                            "lazily inside the init handshake"))
+
+    # 2) anywhere: never the parent-side supervision stack
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.Import, ast.ImportFrom)):
+            for mod in _imported_modules(node):
+                if any(mod == b or mod.startswith(b + ".")
+                       for b in _BANNED_MODULES):
+                    out.append((node.lineno,
+                                f"import of parent-side module {mod!r} "
+                                "(the worker is the supervision leaf)"))
+        if (isinstance(node, ast.ImportFrom)
+                and node.module == "distegnn_tpu.serve"):
+            for alias in node.names:
+                if alias.name in _BANNED_SERVE_ATTRS:
+                    out.append((node.lineno,
+                                f"'from distegnn_tpu.serve import "
+                                f"{alias.name}' reaches the parent-side "
+                                "stack through the package namespace"))
+    return sorted(out)
+
+
+def main(argv=None) -> int:
+    rel = os.path.relpath(WORKER, REPO)
+    violations = find_violations()
+    for lineno, reason in violations:
+        print(f"{rel}:{lineno}: {reason}")
+    if violations:
+        print(f"\n{len(violations)} worker import-isolation breach(es); "
+              "see scripts/check_worker_imports.py docstring")
+        return 1
+    print("check_worker_imports: clean")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

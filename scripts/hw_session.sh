@@ -452,6 +452,42 @@ EOF
 export -f chaos_gateway_and_check
 run_bounded chaos_gateway chaos_gateway_and_check
 
+# 3a''. process-worker chaos drill: the same replay with each replica's
+#       engine in its OWN child process (serve.workers: process,
+#       docs/SERVING.md "Worker processes"). kill9 SIGKILLs one child
+#       mid-replay and sigstop freezes the other — the two faults a thread
+#       backend cannot survive — so the done-marker proves detect →
+#       failover → SIGTERM→SIGKILL escalation → respawn with zero lost
+#       accepted requests on real hardware. Bounded like 3a'.
+chaos_workers_and_check() {
+  local stamp obsdir
+  stamp=$(date -u +%Y%m%dT%H%M%S)
+  obsdir=logs/traffic_gen/hw_chaos_workers_$stamp
+  python scripts/traffic_gen.py --config_path configs/nbody_serve.yaml \
+    --requests 48 --rate 60 --mix "predict=0.8,session=0.2" \
+    --sizes 24,48,96 --sessions 4 --seed 53 --timeout-s 300 \
+    --replicas 2 --workers process \
+    --chaos "kill9@0.5:replica=0;sigstop@2.5:replica=1" \
+    --slo configs/slo_default.yaml --obs-dir "$obsdir" \
+    | tee /tmp/chaos_workers_last.json || return 1
+  python - <<'EOF' || return 1
+import json
+line = [l for l in open('/tmp/chaos_workers_last.json') if l.strip().startswith('{')][-1]
+rec = json.loads(line)
+ok = (rec.get('value', 0) > 0
+      and rec.get('completed', 0) == rec.get('requests', -1)
+      and rec.get('lost', 1) == 0
+      and all(c.get('ok') for c in rec.get('chaos') or []))
+raise SystemExit(0 if ok else 1)
+EOF
+  mkdir -p docs/artifacts
+  cp /tmp/chaos_workers_last.json "docs/artifacts/chaos_workers_$stamp.json"
+  python scripts/obs_report.py "$obsdir/obs/events.jsonl" \
+    --slo configs/slo_default.yaml
+}
+export -f chaos_workers_and_check
+run_bounded chaos_workers chaos_workers_and_check
+
 # 3b. machine roofline probe (minutes): copy/matmul/gather/scatter ceilings
 #     + analytic step floor — pairs with the new hbm_gbps field in the bench
 #     line (VERDICT r4 #7) to place every lowering on the memory roofline.

@@ -18,6 +18,10 @@ Usage:
                  event stream; renders the verdict table and exits 1 on
                  any breach (with --json: the results dict)
 
+Sibling ``events_worker_*.jsonl`` files (written by process-backed serving
+workers) are merged into the stream automatically, so a request served
+across the process boundary still renders one complete waterfall.
+
 The heavy lifting lives in distegnn_tpu.obs.report (pure functions over
 parsed events) so tests drive it without a subprocess. Typical sources:
   <log_dir>/<exp_name>/obs/events.jsonl    (training, process 0)
@@ -34,7 +38,7 @@ import sys
 
 sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
 
-from distegnn_tpu.obs.report import (check, load_events, render_request,
+from distegnn_tpu.obs.report import (check, load_run_events, render_request,
                                      render_text, request_ids_seen,
                                      stitch_request, summarize)
 
@@ -88,19 +92,23 @@ def main(argv=None) -> int:
     if not os.path.exists(args.events):
         print(f"obs_report: no such file: {args.events}", file=sys.stderr)
         return 2
-    events, bad = load_events(args.events)
+    # the full run stream: the named file plus any sibling worker-child
+    # sinks (events_worker_*.jsonl), so cross-process requests stitch
+    events, bad, files = load_run_events(args.events)
+    source = (args.events if len(files) == 1
+              else f"{args.events} (+{len(files) - 1} worker stream(s))")
 
     if args.request is not None:
-        return _report_request(events, args.request, args.events,
+        return _report_request(events, args.request, source,
                                args.as_json)
     if args.slo is not None:
-        return _report_slo(events, args.slo, args.events, args.as_json)
+        return _report_slo(events, args.slo, source, args.as_json)
 
     summary = summarize(events)
     if args.as_json:
         print(json.dumps({**summary, "bad_lines": bad}, sort_keys=True))
     else:
-        print(render_text(summary, source=args.events, bad_lines=bad), end="")
+        print(render_text(summary, source=source, bad_lines=bad), end="")
 
     if args.check:
         fails = check(summary)

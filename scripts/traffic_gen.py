@@ -37,7 +37,12 @@ corrupt reach into the live replica pool via
 distegnn_tpu.testing.serve_faults, swap POSTs the blue/green hot-swap
 through the socket and then fires a fixed probe predict whose
 prediction bytes land in a ``chaos/swap_probe`` event for bitwise
-comparison). Chaos needs the in-process gateway (no ``--url``).
+comparison). Under ``serve.workers: process`` (or ``--workers
+process``) three process-level actions join in: kill9 SIGKILLs a
+replica's worker child, sigstop freezes it (heartbeat-staleness wedge →
+SIGKILL escalation), and spawn_fail arms the next respawn to fail so
+the replica degrades to in-process serving instead of shedding. Chaos
+needs the in-process gateway (no ``--url``).
 Clients honor 429/503 ``Retry-After`` headers with bounded retries
 (``--max-retries``), so a failover blip degrades latency instead of
 losing accepted work.
@@ -96,7 +101,8 @@ def parse_mix(spec: str) -> dict:
     return {k: mix.get(k, 0.0) / total for k in CLASSES}
 
 
-CHAOS_ACTIONS = ("kill", "wedge", "latency", "swap", "corrupt")
+CHAOS_ACTIONS = ("kill", "wedge", "latency", "swap", "corrupt",
+                 "kill9", "sigstop", "spawn_fail")
 
 
 def parse_chaos(spec: str):
@@ -105,7 +111,10 @@ def parse_chaos(spec: str):
     takes ``model=`` (default: first served model); kill/wedge/latency
     take ``replica=`` (kill/wedge default 0, latency default ALL); wedge
     takes ``dur=`` seconds; latency takes ``s=`` seconds; swap/corrupt
-    take ``ckpt=`` and corrupt ``mode=`` (truncate|garbage|headerless)."""
+    take ``ckpt=`` and corrupt ``mode=`` (truncate|garbage|headerless);
+    kill9/sigstop take ``replica=`` (default 0) and need process-backed
+    replicas; spawn_fail takes ``replica=`` and ``n=`` (default 1)
+    respawn attempts to sabotage."""
     events = []
     for part in spec.split(";"):
         part = part.strip()
@@ -286,6 +295,8 @@ def boot_gateway(args, cfg):
         cfg.serve.max_batch = int(args.max_batch)
     if args.replicas is not None:
         cfg.serve.replicas = int(args.replicas)
+    if args.workers is not None:
+        cfg.serve.workers = str(args.workers)
 
     registry = ModelRegistry.from_config(cfg).start()
     registry.warmup(args.size_list)
@@ -365,6 +376,19 @@ def run_chaos(events, t0: float, registry, base_url: str, models,
                 rep = int(kw.get("replica", 0))
                 serve_faults.kill_replica(registry, model, rep)
                 outcome.update(replica=rep, ok=True)
+            elif action == "kill9":
+                rep = int(kw.get("replica", 0))
+                pid = serve_faults.kill9_replica(registry, model, rep)
+                outcome.update(replica=rep, pid=pid, ok=True)
+            elif action == "sigstop":
+                rep = int(kw.get("replica", 0))
+                pid = serve_faults.sigstop_replica(registry, model, rep)
+                outcome.update(replica=rep, pid=pid, ok=True)
+            elif action == "spawn_fail":
+                rep = int(kw.get("replica", 0))
+                n = int(kw.get("n", 1))
+                serve_faults.spawn_failure(registry, model, n, rep)
+                outcome.update(replica=rep, n=n, ok=True)
             elif action == "wedge":
                 rep = int(kw.get("replica", 0))
                 dur = float(kw.get("dur", 5.0))
@@ -569,6 +593,11 @@ def main(argv=None) -> int:
                     help="override serve.max_batch (in-process gateway only)")
     ap.add_argument("--replicas", type=int, default=None,
                     help="override serve.replicas (in-process gateway only)")
+    ap.add_argument("--workers", type=str, default=None,
+                    choices=("thread", "process"),
+                    help="override serve.workers (in-process gateway only): "
+                         "'process' runs each replica in its own worker "
+                         "child behind IPC supervision")
     ap.add_argument("--chaos", type=str, default=None,
                     help="serving fault schedule, e.g. 'kill@0.3:replica=0;"
                          "swap@1.0:ckpt=/p/b.ckpt' (in-process gateway only)")

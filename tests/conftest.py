@@ -35,6 +35,26 @@ def pytest_configure(config):
     # serve tests are tier-1 (NOT slow): CPU-only via JAX_PLATFORMS=cpu, the
     # queue/batcher exercised fully in-process — no network sockets
     config.addinivalue_line("markers", "serve: serving-stack tests (distegnn_tpu/serve)")
+    # process-backed serving worker tests: each spawns at least one real
+    # child interpreter (slow jax import). One smoke test stays tier-1; the
+    # full matrix (chaos drill, swap-under-workers) is additionally `slow`.
+    config.addinivalue_line(
+        "markers", "process: spawns serving worker child processes")
+
+
+@pytest.fixture(autouse=True)
+def _reap_worker_children():
+    """Serving worker children must never outlive their test. The parent-side
+    bookkeeping (worker._LIVE + atexit) covers interpreter exit; this covers
+    the inter-test gap — a FAILED process-marked test can bail between spawn
+    and terminate, and the next test must not inherit its children. Bounded:
+    reap_live_workers escalates SIGTERM → SIGKILL and joins each child."""
+    yield
+    import sys
+
+    wmod = sys.modules.get("distegnn_tpu.serve.worker")
+    if wmod is not None:
+        wmod.reap_live_workers(join_timeout_s=10.0)
 
 
 @pytest.fixture

@@ -210,6 +210,27 @@ def test_supervisor_backoff_doubles_then_breaker_opens(tiny):
         rset.stop()
 
 
+def test_restart_aborts_when_stop_races_it(tiny):
+    """A supervisor restart that completes AFTER shutdown began must not
+    revive the queue: _restart rechecks _supervised once restart_queue
+    returns (a worker spawn can block for seconds, ample time for stop()
+    to start draining) and stops the fresh queue instead of marking the
+    replica running. Regression for the stop()/restart race."""
+    rset = _mk_rset(tiny, 1).start()
+    sup, r = rset.supervisor, rset.replicas[0]
+    r.queue.kill(reason="crash before shutdown")
+    sup.tick(now=100.0)
+    assert r.state == "backoff"
+    # shutdown begins while the replica is still down: supervisor stopped,
+    # drain about to run — then the in-flight restart attempt lands
+    rset.begin_stop()
+    sup._restart(r, now=101.0)
+    assert r.state == "stopped"
+    assert not r.queue.alive()
+    rset.stop()  # idempotent; drains nothing
+    assert r.state == "stopped"
+
+
 # ---- blue/green hot-swap ----------------------------------------------------
 
 def _mk_entry(tiny, n=2, name="m"):

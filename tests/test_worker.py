@@ -1,0 +1,418 @@
+"""Process-isolated serving workers (serve/worker.py + WorkerReplica):
+the length-prefixed checksummed IPC framing, the import-isolation lint,
+one real spawn proving bitwise parent/child parity and clean teardown,
+spawn-failure degradation to in-process serving, and — under ``slow`` —
+the full chaos drill (kill9 + live swap + sigstop under replayed traffic
+with ``serve.workers: process``).
+
+The ``process`` marker flags tests that spawn at least one real worker
+child (a full interpreter + jax import each). Exactly one stays tier-1
+as the smoke test; the drill matrix is additionally ``slow``.
+"""
+
+import json
+import os
+import signal
+import socket
+import struct
+import sys
+import threading
+import time
+import zlib
+from types import SimpleNamespace
+
+import jax
+import numpy as np
+import pytest
+
+from distegnn_tpu.config import ConfigDict, _DEFAULTS
+from distegnn_tpu.serve import synthetic_graph
+from distegnn_tpu.serve import worker as wmod
+from distegnn_tpu.serve.registry import ModelRegistry
+from distegnn_tpu.train.checkpoint import save_checkpoint
+
+pytestmark = pytest.mark.serve
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# ---- IPC framing ------------------------------------------------------------
+
+def _pair():
+    a, b = socket.socketpair()
+    return a, b
+
+
+def test_frame_roundtrip_all_kinds():
+    a, b = _pair()
+    lock = threading.Lock()
+    try:
+        for kind, seq, obj in ((wmod.FRAME_REQUEST, 1, {"op": "ping"}),
+                               (wmod.FRAME_RESPONSE, 1, {"ok": True,
+                                                         "result": [1, 2]}),
+                               (wmod.FRAME_HEARTBEAT, 0, {"ts": 1.5})):
+            wmod.send_frame(a, lock, kind, seq, obj)
+            k, s, payload = wmod.recv_frame(
+                b, deadline=time.monotonic() + 5.0)
+            assert (k, s, payload) == (kind, seq, obj)
+    finally:
+        a.close()
+        b.close()
+
+
+def test_frame_checksum_corruption_is_typed():
+    """A flipped payload byte fails the crc32 check as FrameError — never a
+    pickle of garbage bytes."""
+    a, b = _pair()
+    try:
+        payload = __import__("pickle").dumps({"op": "predict"}, protocol=4)
+        header = wmod._HEADER.pack(wmod._MAGIC, wmod.FRAME_REQUEST, 7,
+                                   len(payload),
+                                   zlib.crc32(payload) & 0xFFFFFFFF)
+        corrupt = bytes([payload[0] ^ 0x40]) + payload[1:]
+        a.sendall(header + corrupt)
+        with pytest.raises(wmod.FrameError, match="checksum"):
+            wmod.recv_frame(b, deadline=time.monotonic() + 5.0)
+    finally:
+        a.close()
+        b.close()
+
+
+def test_frame_bad_magic_is_typed():
+    a, b = _pair()
+    try:
+        a.sendall(struct.pack("!2sBIII", b"XX", 1, 0, 0, 0))
+        with pytest.raises(wmod.FrameError, match="magic"):
+            wmod.recv_frame(b, deadline=time.monotonic() + 5.0)
+    finally:
+        a.close()
+        b.close()
+
+
+def test_frame_eof_and_deadline_are_typed():
+    """A dead pipe is WorkerClosedError and a silent one WorkerTimeoutError
+    — a parent blocked on a worker read NEVER hangs untyped."""
+    a, b = _pair()
+    a.close()
+    try:
+        with pytest.raises(wmod.WorkerClosedError):
+            wmod.recv_frame(b, deadline=time.monotonic() + 5.0)
+    finally:
+        b.close()
+    a, b = _pair()
+    try:
+        t0 = time.monotonic()
+        with pytest.raises(wmod.WorkerTimeoutError):
+            wmod.recv_frame(b, deadline=time.monotonic() + 0.2)
+        assert time.monotonic() - t0 < 5.0
+    finally:
+        a.close()
+        b.close()
+
+
+# ---- lint: the worker child stays import-isolated ---------------------------
+
+def test_worker_import_isolation():
+    """Tier-1 wiring of scripts/check_worker_imports.py: worker.py keeps
+    stdlib-only module-level imports (a broken jax must surface as a typed
+    init failure, not an exec death) and never touches the parent-side
+    transport/registry/supervisor stack."""
+    sys.path.insert(0, os.path.join(REPO, "scripts"))
+    try:
+        from check_worker_imports import find_violations
+    finally:
+        sys.path.pop(0)
+    violations = find_violations()
+    assert violations == [], (
+        "serve/worker.py broke import isolation — see "
+        f"scripts/check_worker_imports.py: {violations}")
+
+
+# ---- process-backed replicas ------------------------------------------------
+
+def _small_cfg(**serve_kw):
+    cfg = ConfigDict(_DEFAULTS)
+    cfg.model.hidden_nf = 16
+    cfg.model.n_layers = 2
+    cfg.model.virtual_channels = 2
+    cfg.serve.workers = "process"
+    cfg.serve.replicas = 1
+    cfg.serve.worker = {"spawn_timeout_s": 300.0, "heartbeat_s": 0.2,
+                        "kill_grace_s": 2.0}
+    for k, v in serve_kw.items():
+        cfg.serve[k] = v
+    return cfg
+
+
+@pytest.mark.process
+def test_worker_spawn_parity_and_clean_teardown():
+    """The tier-1 worker smoke: one process-backed replica spawns (the
+    handshake already asserted the child's params digest equals the
+    parent's), serves a prediction BITWISE-identical to the parent
+    engine's on the same graph, reports pid/heartbeat detail in health,
+    and tears down leaving neither a live child nor a leaked handle."""
+    cfg = _small_cfg()
+    reg = ModelRegistry.from_config(cfg).start()
+    pid = None
+    try:
+        e = reg.get("default")
+        r = e.replicas.replicas[0]
+        assert r.backend == "process" and not r.degraded
+        pid = r.queue.pid
+        assert pid is not None and os.path.exists(f"/proc/{pid}")
+        g = synthetic_graph(24, seed=11,
+                            feat_nf=int(cfg.model.node_feat_nf),
+                            edge_attr_nf=int(cfg.model.edge_attr_nf))
+        out = np.asarray(e.replicas.submit(dict(g)).result(timeout=300.0))
+        ref = np.asarray(e.engine.predict(dict(g)))
+        np.testing.assert_array_equal(out, ref)
+        row = e.replicas.health()[0]
+        assert row["backend"] == "process" and row["pid"] == pid
+        assert row["heartbeat_age_s"] is not None
+        workers = reg.health()["default"]["workers"]
+        assert workers and workers[0]["pid"] == pid
+        assert workers[0]["degraded"] is False
+    finally:
+        reg.stop()
+    assert not wmod._LIVE, "a WorkerHandle leaked past registry.stop()"
+    deadline = time.monotonic() + 10.0
+    while os.path.exists(f"/proc/{pid}") and time.monotonic() < deadline:
+        time.sleep(0.05)
+    assert not os.path.exists(f"/proc/{pid}"), "worker child outlived stop()"
+
+
+def test_spawn_failure_degrades_to_in_process():
+    """A spawn failure at start must DEGRADE, not shed: the replica falls
+    back to an in-process queue on the parent's own params (bitwise the
+    same predictions) and stays schedulable; health says degraded."""
+    cfg = _small_cfg()
+    reg = ModelRegistry.from_config(cfg)
+    e = reg.get("default")
+    r = e.replicas.replicas[0]
+    r.fail_next_spawns(1)
+    reg.start()
+    try:
+        assert r.degraded and r.queue.backend == "thread"
+        assert r.queue.pid is None if hasattr(r.queue, "pid") else True
+        g = synthetic_graph(24, seed=11,
+                            feat_nf=int(cfg.model.node_feat_nf),
+                            edge_attr_nf=int(cfg.model.edge_attr_nf))
+        out = np.asarray(e.replicas.submit(dict(g)).result(timeout=300.0))
+        ref = np.asarray(e.engine.predict(dict(g)))
+        np.testing.assert_array_equal(out, ref)
+        row = e.replicas.health()[0]
+        assert row["degraded"] is True
+    finally:
+        reg.stop()
+    assert not wmod._LIVE
+
+
+# ---- the process chaos drill (slow) -----------------------------------------
+
+def _save_params(path, params):
+    save_checkpoint(str(path),
+                    SimpleNamespace(params=params, opt_state={}, step=0),
+                    epoch=0)
+
+
+@pytest.mark.slow
+@pytest.mark.process
+def test_swap_racing_inflight_spawn_is_caught_up(tmp_path):
+    """A hot-swap that defers WHILE a respawn is in flight must not strand
+    the fresh worker on the pre-swap params. The respawn captured its
+    checkpoint argument and expect_digest seconds before the swap landed
+    (both pre-swap, so the parity handshake passes on OLD params); the
+    post-spawn catch-up in start_queue must detect the divergence and swap
+    the child over IPC before the replica goes back into rotation."""
+    from distegnn_tpu.serve import engine_from_config
+
+    cfg = _small_cfg()
+    reg = ModelRegistry.from_config(cfg)
+    e = reg.get("default")
+    r = e.replicas.replicas[0]
+    reg.start()
+    try:
+        params_b = jax.tree_util.tree_map(
+            lambda x: x * 1.0625, e.engine.params)
+        ck = tmp_path / "b.ckpt"
+        _save_params(ck, params_b)
+        g = synthetic_graph(6, seed=5,
+                            feat_nf=int(cfg.model.node_feat_nf),
+                            edge_attr_nf=int(cfg.model.edge_attr_nf))
+        from distegnn_tpu.models.registry import get_model
+
+        model_b = get_model(cfg.model, dataset_name=cfg.data.dataset_name)
+        eng_b, _ = engine_from_config(cfg, model_b, params=params_b)
+        ref_b = np.asarray(eng_b.predict(dict(g)))
+
+        orig_spawn = r._spawn_worker
+
+        def racing_spawn():
+            # spawn captures checkpoint=None + the OLD expect_digest, then
+            # the swap completes before start_queue's catch-up check runs
+            h = orig_spawn()
+            r.current_checkpoint = str(ck)
+            e.engine.params = params_b
+            return h
+
+        r._spawn_worker = racing_spawn
+        old_pid = r.queue.pid
+        os.kill(old_pid, signal.SIGKILL)
+        deadline = time.monotonic() + 60.0
+        while time.monotonic() < deadline and r.state == "running":
+            time.sleep(0.05)
+        while time.monotonic() < deadline and not (
+                r.healthy() and r.state == "running"
+                and getattr(r.queue, "pid", None) not in (None, old_pid)):
+            time.sleep(0.1)
+        w = r.queue.worker
+        assert w is not None and w.checkpoint == str(ck), \
+            "post-spawn catch-up did not move the worker to the swapped " \
+            "checkpoint"
+        out = np.asarray(e.replicas.submit(dict(g)).result(timeout=300.0))
+        np.testing.assert_array_equal(out, ref_b)
+
+        # residual window: a deferral that lands after the catch-up check is
+        # healed by the supervisor-tick reconcile (parent-side compare only)
+        w.checkpoint = None
+        deadline = time.monotonic() + 10.0
+        while time.monotonic() < deadline and \
+                r.queue.worker.checkpoint != str(ck):
+            time.sleep(0.1)
+        assert r.queue.worker.checkpoint == str(ck)
+    finally:
+        reg.stop()
+    assert not wmod._LIVE
+
+
+@pytest.mark.slow
+@pytest.mark.process
+def test_chaos_drill_process_workers(tmp_path):
+    """The PR's acceptance drill re-run under ``serve.workers: process``:
+    2 worker children per model; kill9 SIGKILLs one mid-replay, a live
+    blue/green swap crosses the IPC boundary, sigstop freezes the other
+    child later. ZERO accepted requests lost, SLO PASS, the event stream
+    shows detect → failover → escalate(SIGKILL) → respawn, the swap probe
+    is bitwise-identical to a cold-started engine on the new checkpoint,
+    and no worker process survives the run."""
+    import base64
+    import subprocess
+
+    from distegnn_tpu.config import load_config
+    from distegnn_tpu.serve import engine_from_config
+
+    yaml_path = tmp_path / "drill.yaml"
+    yaml_path.write_text(
+        "model:\n"
+        "  hidden_nf: 16\n"
+        "  n_layers: 2\n"
+        "  virtual_channels: 2\n"
+        "serve:\n"
+        "  workers: process\n"
+        "  replicas: 2\n"
+        "  request_timeout_ms: 120000\n"
+        "  worker:\n"
+        "    spawn_timeout_s: 300.0\n"
+        "    heartbeat_s: 0.2\n"
+        "    kill_grace_s: 2.0\n"
+        "  supervisor:\n"
+        "    heartbeat_s: 0.1\n"
+        "    wedge_timeout_s: 30.0\n"
+        "    worker_heartbeat_timeout_s: 1.5\n"
+        "    backoff_base_s: 0.25\n"
+        "    backoff_max_s: 2.0\n"
+        "    breaker_threshold: 5\n"
+        "    breaker_cooldown_s: 5.0\n"
+        "    healthy_reset_s: 60.0\n"
+        "seed: 43\n")
+    cfg = load_config(str(yaml_path))
+    # same deterministic init path the subprocess gateway runs, so the swap
+    # checkpoint is structurally identical to the params being served
+    entry = ModelRegistry.from_config(cfg).get("default")
+    params_b = jax.tree.map(lambda x: x * 1.0625, entry.engine.params)
+    ck = tmp_path / "b.ckpt"
+    _save_params(ck, params_b)
+    spec = tmp_path / "slo.yaml"
+    spec.write_text("slo:\n"
+                    "  routes:\n"
+                    "    predict:\n"
+                    "      p99_ms: 90000\n"
+                    "  error_rate_max: 0.0\n")
+    obs_dir = tmp_path / "tg"
+
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO, "scripts", "traffic_gen.py"),
+         "--config_path", str(yaml_path),
+         "--requests", "48", "--rate", "10", "--mix", "predict=1.0",
+         "--sizes", "24", "--seed", "7", "--timeout-s", "240",
+         "--chaos", (f"latency@0.05:s=0.05;kill9@0.5:replica=0;"
+                     f"swap@2.5:ckpt={ck};sigstop@4.0:replica=1"),
+         "--slo", str(spec), "--obs-dir", str(obs_dir)],
+        capture_output=True, text=True, cwd=REPO, timeout=580,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"})
+    assert r.returncode == 0, r.stderr[-4000:]
+    lines = [ln for ln in r.stdout.strip().splitlines() if ln]
+    assert len(lines) == 1, r.stdout
+    rec = json.loads(lines[0])
+
+    # zero accepted requests lost through SIGKILL + SIGSTOP; SLO holds
+    assert rec["completed"] == 48 and rec["lost"] == 0
+    assert rec["errors"] == 0
+    assert rec["slo"]["pass"] is True, rec["slo"]
+    by_action = {c["action"]: c for c in rec["chaos"]}
+    assert by_action["kill9"]["ok"] is True
+    assert by_action["sigstop"]["ok"] is True
+    assert by_action["swap"]["ok"] is True
+    assert by_action["swap"]["swap"]["version"] == 1
+
+    # detect -> failover -> escalate -> respawn, visible in the stream
+    events = []
+    with open(obs_dir / "obs" / "events.jsonl") as f:
+        for line in f:
+            events.append(json.loads(line))
+    names = [e.get("name") for e in events]
+    assert "gateway/worker_spawn" in names
+    assert "gateway/replica_crash" in names       # kill9 detected
+    assert "gateway/replica_wedge" in names       # sigstop: heartbeat stale
+    exits = [e for e in events if e.get("name") == "gateway/worker_exit"]
+    assert any(e.get("escalated") for e in exits), (
+        "the SIGSTOPped child was never SIGKILL-escalated")
+    assert "gateway/replica_restart" in names     # at least one respawn
+    # the worker children produced their own stitched event streams
+    worker_streams = [p for p in os.listdir(obs_dir / "obs")
+                      if p.startswith("events_worker_")]
+    assert worker_streams, "no worker-side event stream was written"
+
+    # no orphan worker processes survive the run
+    leftovers = []
+    for pid in os.listdir("/proc"):
+        if not pid.isdigit():
+            continue
+        try:
+            with open(f"/proc/{pid}/cmdline", "rb") as f:
+                cmd = f.read().decode(errors="replace")
+        except OSError:
+            continue
+        if "distegnn_tpu.serve.worker" in cmd:
+            leftovers.append(pid)
+    assert leftovers == [], f"orphan worker processes: {leftovers}"
+
+    # the swapped live gateway's probe prediction, bit for bit
+    probe = next((e for e in events if e.get("name") == "chaos/swap_probe"),
+                 None)
+    assert probe is not None, "swap probe never fired"
+    pd = probe["prediction"]
+    live = np.frombuffer(base64.b64decode(pd["b64"]),
+                         dtype="<f4").reshape(pd["shape"])
+    g = synthetic_graph(24, seed=1234, feat_nf=int(cfg.model.node_feat_nf),
+                        edge_attr_nf=int(cfg.model.edge_attr_nf))
+    for k in ("loc", "vel", "node_feat", "edge_attr"):
+        g[k] = np.ascontiguousarray(g[k], dtype="<f4")
+    g["edge_index"] = np.ascontiguousarray(g["edge_index"], dtype="<i4")
+    from distegnn_tpu.models.registry import get_model
+
+    model = get_model(cfg.model, dataset_name=cfg.data.dataset_name)
+    eng, q = engine_from_config(cfg, model, params=params_b)
+    with q:
+        cold = q.submit(g).result(timeout=240.0)
+    np.testing.assert_array_equal(live, np.asarray(cold, dtype="<f4"))

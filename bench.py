@@ -20,6 +20,15 @@ Layouts (docs/PERFORMANCE.md):
                  kernel (model.edge_impl='fused', ops/edge_pipeline.py): one
                  streamed pass per layer over the in-window edges + a compact
                  remote tail through plain ops (docs/PERFORMANCE.md)
+  fused_stack  — the cross-layer megakernel (model.edge_impl='fused_stack',
+                 ops/layer_pipeline.py): ALL n_layers run inside one Pallas
+                 grid with the graph resident in VMEM. The flagship 113k
+                 shape exceeds the 16 MiB VMEM budget by design, so this leg
+                 runs at a bounded node count (BENCH_STACK_NODES, default
+                 1536 — the largest padded shape that passes
+                 check_stack_vmem at Fluid113K edge density) and reports no
+                 vs_baseline; it is an HBM-traffic A/B against the fused leg
+                 at the SAME capped shape, not a flagship headline.
 Default is auto: race the production candidates in RACE_ORDER — the fused
 edge pipeline first, then cumsum/remat/agg-dtype stacks and the
 unfused/unreordered anchor control — each in a child process (so a compiler
@@ -135,6 +144,11 @@ PAUSED_PIDS_FILE = "/tmp/bench_paused.pids"
 # (0.784x, 0.446x) are hardware-refuted and retired.
 # tests/test_bench_unlosable.py traces EVERY leg here on CPU.
 RACE_ORDER = (
+    # Cross-layer megakernel first: unmeasured on hardware and the highest-
+    # information leg (it is the direct HBM-traffic answer to the fused leg).
+    # Self-caps to BENCH_STACK_NODES (VMEM-resident stack), so its number is
+    # an A/B vs the fused leg at the same capped shape, never the headline.
+    (["--layout", "fused_stack"], None),
     (["--layout", "fused"], None),
     (["--layout", "plain", "--seg", "cumsum"],
      {"BENCH_AGG_DTYPE": "bf16", "BENCH_REMAT": "1"}),
@@ -287,6 +301,8 @@ def layout_tag(edge_block: int, impl: str, seg: str = "scatter",
                edge_impl: str = "plain") -> str:
     """The machine-read layout label shared by bench.py and profile_step.py
     outputs (pasted into BASELINE.md tables)."""
+    if edge_impl == "fused_stack":
+        return f"fused_stack{edge_block}"
     if edge_impl == "fused":
         return f"fused{edge_block}"
     if edge_block:
@@ -306,7 +322,8 @@ def measure(edge_block: int, impl: str = "einsum", seg: str = "scatter",
     batch, n_edges = make_fluid_batch(rng, edge_block,
                                       pairing=(seg in ("cumsum", "ell")),
                                       edge_tile=edge_tile,
-                                      split_remote=(edge_impl == "fused"))
+                                      split_remote=(edge_impl in
+                                                    ("fused", "fused_stack")))
 
     model = FastEGNN(node_feat_nf=3, node_attr_nf=2, edge_attr_nf=2,
                      hidden_nf=HIDDEN, virtual_channels=CHANNELS, n_layers=LAYERS,
@@ -498,10 +515,10 @@ def main():
 
     args = sys.argv[1:]
     layout, impl, seg, fuse, mesh_str = "auto", "einsum", "scatter", True, None
-    usage = ("usage: bench.py [--layout plain|blocked|fused|auto] "
+    usage = ("usage: bench.py [--layout plain|blocked|fused|fused_stack|auto] "
              "[--impl pallas|einsum] [--seg scatter|cumsum|ell] "
              "[--fuse 0|1] [--mesh DxGxT]  "
-             "(env: BENCH_REORDER, BENCH_AGG_DTYPE)")
+             "(env: BENCH_REORDER, BENCH_AGG_DTYPE, BENCH_STACK_NODES)")
     if "--mesh" in args:
         i = args.index("--mesh")
         if i + 1 >= len(args) or not re.fullmatch(r"\d+x\d+x\d+",
@@ -511,7 +528,8 @@ def main():
     if "--layout" in args:
         i = args.index("--layout")
         if i + 1 >= len(args) or args[i + 1] not in ("plain", "blocked", "fused",
-                                                     "auto", "probe"):
+                                                     "fused_stack", "auto",
+                                                     "probe"):
             sys.exit(usage)
         layout = args[i + 1]
     if "--impl" in args:
@@ -567,6 +585,24 @@ def main():
         # multiple of it); BENCH_FUSED_BLOCK overrides for VMEM-window sweeps
         fb = _env_int("BENCH_FUSED_BLOCK", 512)
         _emit_bench(measure(fb, impl, seg, fuse, edge_impl="fused"))
+        return
+    if layout == "fused_stack":
+        # Cross-layer megakernel: the whole L-layer stack must be VMEM-
+        # resident, and the flagship 113k shape exceeds the 16 MiB budget by
+        # design (ops/layer_pipeline.check_stack_vmem would raise its typed
+        # error at trace time). Self-cap to the largest padded shape that
+        # fits at Fluid113K density rather than fail-record the leg; the
+        # resulting number is an A/B vs --layout fused at the SAME node
+        # count, and official/vs_baseline is already None off-workload.
+        global N_NODES
+        cap = _env_int("BENCH_STACK_NODES", 1536)
+        if N_NODES > cap:
+            print(f"bench: fused_stack leg capped at N={cap} "
+                  f"(VMEM-resident stack; N={N_NODES} exceeds the "
+                  f"default 16 MiB budget)", file=sys.stderr)
+            N_NODES = cap
+        fb = _env_int("BENCH_FUSED_BLOCK", 512)
+        _emit_bench(measure(fb, impl, seg, fuse, edge_impl="fused_stack"))
         return
     if layout in ("plain", "blocked"):
         _emit_bench(measure(edge_block if layout == "blocked" else 0,

@@ -365,6 +365,8 @@ _CLI_FIELDS = {
     "tensor_parallel": ("parallel.mesh.tensor", int),
     # resilience: 'auto' or an explicit checkpoint path (train.resume)
     "resume": ("train.resume", str),
+    # real-edge lowering: plain | fused | fused_stack (model.edge_impl)
+    "edge_impl": ("model.edge_impl", str),
 }
 
 
@@ -436,22 +438,42 @@ def validate_config(cfg: ConfigDict) -> None:
     if cfg.model.virtual_channels < 1:
         raise ValueError("model.virtual_channels must be >= 1")
     edge_impl = cfg.model.get("edge_impl", "plain")
-    if edge_impl not in ("plain", "fused"):
-        raise ValueError("model.edge_impl must be 'plain' or 'fused'")
-    if edge_impl == "fused":
+    if edge_impl not in ("plain", "fused", "fused_stack"):
+        raise ValueError(
+            "model.edge_impl must be 'plain', 'fused', or 'fused_stack'")
+    if edge_impl in ("fused", "fused_stack"):
         from distegnn_tpu.ops.edge_pipeline import OH_CHUNK
 
         blk = int(cfg.data.edge_block)
         if blk < OH_CHUNK or blk % OH_CHUNK:
             raise ValueError(
-                f"model.edge_impl='fused' requires data.edge_block >= {OH_CHUNK} "
-                f"and a multiple of {OH_CHUNK} (got {blk})")
+                f"model.edge_impl='{edge_impl}' requires data.edge_block >= "
+                f"{OH_CHUNK} and a multiple of {OH_CHUNK} (got {blk})")
         if int(cfg.model.edge_attr_nf) != 2:
-            raise ValueError("model.edge_impl='fused' requires edge_attr_nf == 2 "
+            raise ValueError(f"model.edge_impl='{edge_impl}' requires "
+                             "edge_attr_nf == 2 "
                              "(the kernel's scalar lane layout is fixed)")
         if bool(cfg.model.normalize):
-            raise ValueError("model.edge_impl='fused' does not support "
+            raise ValueError(f"model.edge_impl='{edge_impl}' does not support "
                              "model.normalize (flagship EGCL only)")
+    if edge_impl == "fused_stack":
+        # fused's constraints PLUS a layer-grid + VMEM-residency contract:
+        # the megakernel grid is (n_layers,) and the whole blocked graph
+        # must fit the per-core VMEM budget — the residency estimate is
+        # shape-dependent, so the hard gate lives at trace time
+        # (ops/layer_pipeline raises StackVmemBudgetError naming the bound);
+        # here we validate what the config alone can know.
+        if int(cfg.model.n_layers) < 1:
+            raise ValueError(
+                "model.edge_impl='fused_stack' requires model.n_layers >= 1 "
+                "(the megakernel grid runs one step per layer)")
+        budget = int(cfg.model.get("stack_vmem_budget", 0) or 0)
+        if budget < 0:
+            raise ValueError(
+                "model.stack_vmem_budget must be >= 0 bytes (0 = the "
+                "16 MiB/core default; the fused_stack megakernel raises "
+                "StackVmemBudgetError at trace time when the VMEM-resident "
+                "graph exceeds this bound)")
     par = cfg.get("parallel")
     mesh = par.get("mesh") if par is not None else None
     if mesh is not None:

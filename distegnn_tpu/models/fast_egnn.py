@@ -24,12 +24,15 @@ import jax.numpy as jnp
 from flax import linen as nn
 
 from distegnn_tpu.models.common import (
-    MLP, CoordMLP, HoistedEdgeMLP, TorchDense, _torch_bias_init,
-    coord_head_init, gather_nodes, resolve_dtype, torch_linear_init,
+    MLP, CoordMLP, HoistedEdgeMLP, TorchDense, _TorchDenseParams,
+    _torch_bias_init, coord_head_init, gather_nodes, resolve_dtype,
+    torch_linear_init,
 )
 from distegnn_tpu.ops.blocked import EdgeOps, blocked_slot_inv_deg
 from distegnn_tpu.ops.edge_pipeline import (EdgeWeights, build_edge_blocks,
                                             fused_edge_layer)
+from distegnn_tpu.ops.layer_pipeline import (DEFAULT_STACK_VMEM_BUDGET,
+                                             StackConfig, fused_egnn_stack)
 from distegnn_tpu.ops.graph import GraphBatch
 from distegnn_tpu.parallel.collectives import (
     global_node_mean, tp_copy, tp_gather, tp_once, tp_reduce, tp_slice,
@@ -61,6 +64,95 @@ class FusedEdgeParams(nn.Module):
         b3 = self.param("b3", _torch_bias_init(H), (H,), jnp.float32)
         w4 = self.param("w4", coord_head_init, (H, 1), jnp.float32)
         return w1, b1, w2, b2, w3, b3, w4
+
+
+class _MLPParams(nn.Module):
+    """Parameter-only shadow of :class:`common.MLP` (non-TP path): declares
+    the identical ``TorchDense_{i}/Dense_0/{kernel,bias}`` subtree — same
+    names, shapes, and initializers — without the compute. Flax derives init
+    RNG from the module PATH, so a checkpoint is bitwise interchangeable
+    between this and the real MLP (the precedent is MLP's own tensor-parallel
+    branch, which does the same with _TorchDenseParams). The fused_stack
+    megakernel uses these to own the whole layer loop while keeping the
+    param tree identical to the per-layer EGCLVel modules."""
+
+    sizes: Tuple[int, ...]
+    use_bias_last: bool = True
+    kernel_init_last: Optional[object] = None
+
+    @nn.compact
+    def __call__(self, fan_in: int):
+        outs = []
+        f = fan_in
+        for i, s in enumerate(self.sizes):
+            last = i == len(self.sizes) - 1
+            outs.append(_TorchDenseParams(
+                s, use_bias=(self.use_bias_last if last else True),
+                kernel_init=(self.kernel_init_last if last else None),
+                name=f"TorchDense_{i}")(f))
+            f = s
+        return outs
+
+
+class _CoordMLPParams(nn.Module):
+    """Parameter-only shadow of :class:`common.CoordMLP` (``MLP_0`` subtree:
+    Dense(H) + biasless coord-head Dense(1) with coord_head_init)."""
+
+    hidden_nf: int
+
+    @nn.compact
+    def __call__(self, fan_in: int):
+        return _MLPParams([self.hidden_nf, 1], use_bias_last=False,
+                          kernel_init_last=coord_head_init,
+                          name="MLP_0")(fan_in)
+
+
+class _EGCLVelStackParams(nn.Module):
+    """Parameter-only shadow of one fused-path EGCLVel layer, returned in the
+    megakernel's flat weight layout (ops/layer_pipeline.stack_weight_shapes).
+
+    Declares exactly the subtree EGCLVel's ``edge_impl='fused'`` branch
+    declares — phi_e_fused raw arrays plus the phi_ev/phi_xv/phi_X/phi_v/
+    phi_h/phi_hv (+phi_g) MLP stacks — so ``edge_impl: fused_stack`` shares
+    checkpoints bitwise with ``fused``: the [L, a, b] stacking that
+    fused_egnn_stack consumes is a runtime VIEW (stack/transpose/row-bias
+    reshape), not a different tree."""
+
+    hidden_nf: int
+    virtual_channels: int
+    node_attr_nf: int
+    edge_attr_nf: int
+    has_gravity: bool
+
+    @nn.compact
+    def __call__(self):
+        H, C, A = self.hidden_nf, self.virtual_channels, self.node_attr_nf
+        w1, b1, w2, b2, w3, b3, w4 = FusedEdgeParams(
+            H, 1 + self.edge_attr_nf, name="phi_e_fused")()
+        ev = _MLPParams([H, H], name="phi_ev")(2 * H + 1 + C)
+        xv = _CoordMLPParams(H, name="phi_xv")(H)
+        Xh = _CoordMLPParams(H, name="phi_X")(H)
+        vv = _MLPParams([H, 1], name="phi_v")(H)
+        hh = _MLPParams([H, H], name="phi_h")(3 * H + A)
+        hv = _MLPParams([H, H], name="phi_hv")(2 * H)
+        row = lambda b: b[None]                  # [F] bias -> [1, F] row view
+        w = {"e_w1": w1, "e_b1": row(b1), "e_w2": w2, "e_b2": row(b2),
+             "e_w3": w3, "e_b3": row(b3), "e_w4": w4.T,
+             "ev_k0": ev[0][0], "ev_b0": row(ev[0][1]),
+             "ev_k1": ev[1][0], "ev_b1": row(ev[1][1]),
+             "xv_k0": xv[0][0], "xv_b0": row(xv[0][1]), "xv_k1": xv[1][0],
+             "X_k0": Xh[0][0], "X_b0": row(Xh[0][1]), "X_k1": Xh[1][0],
+             "v_k0": vv[0][0], "v_b0": row(vv[0][1]),
+             "v_k1": vv[1][0], "v_b1": vv[1][1].reshape(1, 1),
+             "h_k0": hh[0][0], "h_b0": row(hh[0][1]),
+             "h_k1": hh[1][0], "h_b1": row(hh[1][1]),
+             "hv_k0": hv[0][0], "hv_b0": row(hv[0][1]),
+             "hv_k1": hv[1][0], "hv_b1": row(hv[1][1])}
+        if self.has_gravity:
+            gg = _MLPParams([H, 1], name="phi_g")(H)
+            w.update({"g_k0": gg[0][0], "g_b0": row(gg[0][1]),
+                      "g_k1": gg[1][0], "g_b1": gg[1][1].reshape(1, 1)})
+        return w
 
 
 class EGCLVel(nn.Module):
@@ -416,11 +508,24 @@ class FastEGNN(nn.Module):
     remat: bool = False
     fuse_agg: bool = True          # packed per-layer aggregation (EGCLVel)
     agg_dtype: Optional[str] = None  # 'bf16' packed-aggregation stream (EGCLVel)
-    # real-edge lowering (EGCLVel): 'plain' or 'fused' (single Pallas pass
-    # per layer over the blocked in-window edges, ops/edge_pipeline). Fused
-    # requires a blocked batch (edge_block >= 512, multiple of 512, N >= 3
-    # blocks) built with split_remote=True, and edge_attr_nf == 2.
+    # real-edge lowering (EGCLVel): 'plain', 'fused' (single Pallas pass
+    # per layer over the blocked in-window edges, ops/edge_pipeline), or
+    # 'fused_stack' (ONE Pallas megakernel running all n_layers with the
+    # blocked edge stream VMEM-resident, ops/layer_pipeline — same
+    # constraints as 'fused' plus the whole graph must fit the VMEM budget;
+    # raises layer_pipeline.StackVmemBudgetError otherwise). 'fused' and
+    # 'fused_stack' require a blocked batch (edge_block >= 512, multiple of
+    # 512, N >= 3 blocks) built with split_remote=True, and
+    # edge_attr_nf == 2. 'fused' <-> 'fused_stack' share the param tree
+    # bitwise (checkpoints interchangeable); 'plain' does not. Under a
+    # graph/tensor mesh 'fused_stack' falls back to the per-layer fused
+    # path (identical math and tree): the layer-boundary collectives cannot
+    # cross a Pallas grid — the megakernel is the single-chip lowering that
+    # serving replicas and single-host training use.
     edge_impl: str = "plain"
+    # optional VMEM budget override (bytes) for the fused_stack residency
+    # guard; 0 = layer_pipeline.DEFAULT_STACK_VMEM_BUDGET (16 MiB/core)
+    stack_vmem_budget: int = 0
 
     @nn.compact
     def __call__(self, g: GraphBatch) -> Tuple[jnp.ndarray, jnp.ndarray]:
@@ -445,17 +550,51 @@ class FastEGNN(nn.Module):
         # fused edge pipeline: the kernel's blocked HBM layout of the edge
         # stream is layer-invariant too — build it once per forward
         fused_arrs = None
-        if self.edge_impl == "fused":
+        if self.edge_impl in ("fused", "fused_stack"):
             if g.edge_block <= 0:
                 raise ValueError(
-                    "edge_impl='fused' requires a blocked batch "
+                    f"edge_impl='{self.edge_impl}' requires a blocked batch "
                     "(data.edge_block >= 512, a multiple of 512)")
             fused_arrs = jax.vmap(
                 lambda r, c, ea, em: build_edge_blocks(
                     r, c, ea, em, block=g.edge_block, n_nodes=g.max_nodes)
             )(g.row, g.col, g.edge_attr, g.edge_mask)
 
+        if self.edge_impl == "fused_stack":
+            # megakernel constraints, hoisted to the model because the
+            # megakernel bypasses EGCLVel entirely (mirrors its fused checks)
+            if self.attention or self.normalize or self.tanh:
+                raise ValueError(
+                    "edge_impl='fused_stack' supports the flagship EGCL "
+                    "only: attention/normalize/tanh are baked out of the "
+                    "megakernel — use edge_impl='plain' with those heads")
+            if self.edge_attr_nf != 2:
+                raise ValueError(
+                    f"edge_impl='fused_stack' requires edge_attr_nf=2 (the "
+                    f"kernel scalar lanes are [radial, attr0, attr1]); got "
+                    f"{self.edge_attr_nf}")
+            if self.n_layers < 1:
+                raise ValueError(
+                    f"edge_impl='fused_stack' needs n_layers >= 1 (the "
+                    f"megakernel grid is (n_layers,)); got {self.n_layers}")
+            if g.remote_edge_index is None:
+                raise ValueError(
+                    "edge_impl='fused_stack' needs a blocked batch built "
+                    "with split_remote=True (the megakernel folds the "
+                    "compact remote tail in per layer) — check "
+                    "data.edge_block and the loader's split_remote flag")
+
+        if (self.edge_impl == "fused_stack" and self.axis_name is None
+                and self.tensor_axis is None):
+            return self._fused_stack_forward(g, h, x, v, X, Hv, gravity,
+                                             fused_arrs)
+
         layer_cls = nn.remat(EGCLVel) if self.remat else EGCLVel
+        # under a graph/tensor mesh fused_stack lowers to the per-layer
+        # fused path: collectives cannot cross the megakernel's Pallas grid,
+        # and the param tree is identical so the fallback is exact
+        layer_impl = ("fused" if self.edge_impl == "fused_stack"
+                      else self.edge_impl)
         for i in range(self.n_layers):
             h, x, Hv, X = layer_cls(
                 hidden_nf=H,
@@ -474,9 +613,47 @@ class FastEGNN(nn.Module):
                 seg_impl=self.segment_impl,
                 fuse_agg=self.fuse_agg,
                 agg_dtype=self.agg_dtype,
-                edge_impl=self.edge_impl,
+                edge_impl=layer_impl,
                 name=f"gcl_{i}",
             )(h, x, v, X, Hv, g, gravity=gravity, slot=slot, inv_deg=inv_deg,
               oh=oh, fused_arrs=fused_arrs)
 
         return x, X
+
+    def _fused_stack_forward(self, g: GraphBatch, h, x, v, X, Hv, gravity,
+                             fused_arrs):
+        """Dispatch the whole layer loop as ONE megakernel per graph.
+
+        Params are declared through the _EGCLVelStackParams shadows (same
+        ``gcl_{i}/...`` subtree as the per-layer path, bitwise-identical
+        init) and stacked along a leading layer axis at runtime; the
+        blocked edge stream is read from HBM once for all n_layers."""
+        H, C, B = self.hidden_nf, self.virtual_channels, g.batch_size
+        dt = resolve_dtype(self.compute_dtype)
+        cfg = StackConfig(
+            n_layers=self.n_layers, block=g.edge_block, hidden=H, channels=C,
+            node_attr_nf=self.node_attr_nf,
+            has_gravity=self.gravity is not None, residual=self.residual,
+            coords_mean=True,  # FastEGNN always aggregates with 'mean'
+            dtype_name="bf16" if dt is jnp.bfloat16 else "f32",
+            vmem_budget=self.stack_vmem_budget or DEFAULT_STACK_VMEM_BUDGET)
+        wlayers = [
+            _EGCLVelStackParams(H, C, self.node_attr_nf, self.edge_attr_nf,
+                                self.gravity is not None, name=f"gcl_{i}")()
+            for i in range(self.n_layers)]
+        wstack = {k: jnp.stack([wl[k] for wl in wlayers])
+                  for k in wlayers[0]}
+        row_t, col_l, kblk, scal = fused_arrs
+        xs, Xs = [], []
+        for b in range(B):
+            edge_arrs = (row_t[b], col_l[b], kblk[b], scal[b])
+            remote_arrs = (g.remote_edge_index[b, 0],
+                           g.remote_edge_index[b, 1],
+                           g.remote_edge_attr[b], g.remote_edge_mask[b])
+            _, x_b, X_b, _ = fused_egnn_stack(
+                cfg, h[b], x[b], v[b], X[b], Hv[b], g.node_mask[b],
+                g.node_attr[b] if self.node_attr_nf else None, gravity,
+                edge_arrs, remote_arrs, wstack)
+            xs.append(x_b)
+            Xs.append(X_b)
+        return jnp.stack(xs), jnp.stack(Xs)

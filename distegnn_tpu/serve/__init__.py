@@ -61,8 +61,9 @@ def engine_from_config(cfg, model, params, metrics=None):
         max_nodes=s.max_nodes, max_edges=s.max_edges)
     metrics = metrics or ServeMetrics()
     layout = None
-    if cfg.get("model") and cfg.model.get("edge_impl") == "fused":
-        # fused models only consume blocked split_remote batches
+    if cfg.get("model") and cfg.model.get("edge_impl") in ("fused",
+                                                             "fused_stack"):
+        # fused/fused_stack models only consume blocked split_remote batches
         layout = dict(edge_block=int(cfg.data.edge_block),
                       split_remote=True)
     engine = InferenceEngine(

@@ -123,6 +123,13 @@ class InferenceEngine:
         if donate == "auto":
             donate = jax.default_backend() == "tpu"
         self._donate = bool(donate)
+        # the executable's identity includes the model's edge path and, for
+        # fused_stack, the stack depth: one multi-layer kernel per (rung, L).
+        # A blue/green swap to a different depth must not reuse the old one.
+        _impl = str(getattr(model, "edge_impl", "plain") or "plain")
+        self._stack_key: Tuple = (
+            _impl, int(getattr(model, "n_layers", 0) or 0)
+            if _impl == "fused_stack" else 0)
         self._cache: "OrderedDict[Tuple, Callable]" = OrderedDict()
         # one lock for the cache; device execution itself is serialized by
         # the runtime, and the batcher calls from a single dispatch thread
@@ -187,7 +194,8 @@ class InferenceEngine:
         rpad = (batch.remote_edge_mask.shape[-1]
                 if batch.remote_edge_mask is not None else 0)
         fn = self._compiled(("predict", batch.max_nodes, batch.max_edges,
-                             batch.edge_block, rpad, self.max_batch),
+                             batch.edge_block, rpad, self.max_batch)
+                            + self._stack_key,
                             lambda: self._build_predict(bucket))
         with obs.span("serve/execute", n=batch.max_nodes, e=batch.max_edges,
                       filled=n_real, capacity=self.max_batch,
@@ -277,7 +285,8 @@ class InferenceEngine:
             rpad = (batch.remote_edge_mask.shape[-1]
                     if batch.remote_edge_mask is not None else 0)
             fn = self._compiled(("predict", batch.max_nodes, batch.max_edges,
-                                 batch.edge_block, rpad, self.max_batch),
+                                 batch.edge_block, rpad, self.max_batch)
+                                + self._stack_key,
                                 lambda: self._build_predict(b))
             out = np.asarray(fn(params, batch))
             if out.shape != (self.max_batch, batch.max_nodes, 3):
@@ -347,7 +356,8 @@ class InferenceEngine:
             ro = make_rollout_fn(self.model, **opts)
             return jax.jit(functools.partial(ro, steps=int(steps)))
 
-        fn = self._compiled(("rollout", n_pad, int(steps)), build)
+        fn = self._compiled(("rollout", n_pad, int(steps)) + self._stack_key,
+                            build)
         traj, over = fn(self.params, jnp.asarray(loc_p), jnp.asarray(vel_p),
                         jnp.asarray(mask))
         if bool(np.asarray(over).any()):
@@ -405,7 +415,8 @@ class InferenceEngine:
             ro = make_batched_rollout_fn(self.model, **opts)
             return jax.jit(functools.partial(ro, steps=steps))
 
-        fn = self._compiled(("rollout_batch", n_pad, steps, B), build)
+        fn = self._compiled(("rollout_batch", n_pad, steps, B)
+                            + self._stack_key, build)
         with obs.span("serve/execute", n=n_pad, e=0, filled=len(scenes),
                       capacity=B, workload="rollout", steps=steps,
                       **_rid_attrs(request_ids)):

@@ -307,6 +307,21 @@ EOF
   cp /tmp/bench_fused_last.json \
      "docs/artifacts/bench_fused_$(date -u +%Y%m%dT%H%M%S).json"
 }
+# 0a. cross-layer megakernel leg (model.edge_impl='fused_stack'): also never
+#     hardware-measured. bench.py self-caps the node count to the VMEM budget
+#     (BENCH_STACK_NODES, default 1536), so this leg is an A/B against the
+#     fused leg at the capped shape — bounded and dated like every other leg.
+stack_leg_and_check() {
+  python bench.py --layout fused_stack | tee /tmp/bench_fused_stack_last.json
+  python - <<'EOF' || return 1
+import json
+line = [l for l in open('/tmp/bench_fused_stack_last.json') if l.strip().startswith('{')][-1]
+raise SystemExit(0 if json.loads(line)['value'] > 0 else 1)
+EOF
+  mkdir -p docs/artifacts
+  cp /tmp/bench_fused_stack_last.json \
+     "docs/artifacts/bench_fused_stack_$(date -u +%Y%m%dT%H%M%S).json"
+}
 # 0b. 3-axis mesh leg: the tensor-parallel hidden-dim split (parallel.mesh,
 #     docs/PERFORMANCE.md "3D mesh") timed on real chips — data=1 x graph=1 x
 #     tensor=2 so it fits any 2+-chip tunnel slice. Bounded like every other
@@ -324,8 +339,10 @@ EOF
   cp /tmp/bench_mesh3d_last.json \
      "docs/artifacts/bench_mesh3d_$(date -u +%Y%m%dT%H%M%S).json"
 }
-export -f mesh3d_leg_and_check fused_leg_and_check bench_and_check  # run_bounded's bash -c needs them
+export -f mesh3d_leg_and_check fused_leg_and_check stack_leg_and_check \
+          bench_and_check  # run_bounded's bash -c needs them
 run_bounded bench_fused fused_leg_and_check
+run_bounded bench_fused_stack stack_leg_and_check
 run_bounded bench_mesh3d mesh3d_leg_and_check
 # 1. headline bench: auto races fused / plain-cumsum stacks / plain-scatter
 #    anchor in child processes (bench.RACE_ORDER) and reports the fastest

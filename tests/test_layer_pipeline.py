@@ -1,0 +1,216 @@
+"""Parity and guard-rail tests for the cross-layer megakernel
+(``edge_impl='fused_stack'``, ops/layer_pipeline.py) against the per-layer
+fused pipeline on the SAME FastEGNN weights — the two impls share one param
+tree bitwise, so no remapping is involved. The workload mirrors
+test_fused_model.py: a non-empty remote-edge tail AND a trailing all-padding
+node block, so every sub-path of the megakernel (in-window stream, remote
+gather/scatter tail, empty-block masking) is exercised at L in {1, 2, 4}.
+
+Tolerances are tighter than the fused-vs-plain tests (1e-6 fwd / 1e-5 grad,
+scale-normalized): both sides run the identical bf16-stream math, and the
+only divergence left is ulp-level cross-program XLA codegen amplified at the
+bf16 hi/lo split boundaries — which collapses at real init scales (the
+coord head initializes at variance 1e-6)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+from jax.flatten_util import ravel_pytree
+
+from distegnn_tpu.models.fast_egnn import FastEGNN
+from distegnn_tpu.ops.graph import pad_graphs
+from distegnn_tpu.ops.layer_pipeline import (
+    DEFAULT_STACK_VMEM_BUDGET,
+    StackConfig,
+    StackVmemBudgetError,
+    check_stack_vmem,
+    hbm_bytes_per_step,
+)
+from distegnn_tpu.train.step import TrainState, make_train_step
+
+BLOCK = 512
+N_REAL = 4 * BLOCK          # blocks 0-3 hold real nodes
+N_PAD = 5 * BLOCK           # block 4 is ALL padding (trailing empty block)
+H = 16
+DEPTHS = (1, 2, 4)
+# tier-1 keeps the L=2 parity chain (fwd/grad/full-train-step) plus the cheap
+# L=1 forward; the deeper/duplicate depth cases ride the slow lane so the
+# suite stays inside the tier-1 wall-clock budget on a 1-core CPU box.
+FWD_DEPTHS = (1, 2, pytest.param(4, marks=pytest.mark.slow))
+GRAD_DEPTHS = (pytest.param(1, marks=pytest.mark.slow), 2,
+               pytest.param(4, marks=pytest.mark.slow))
+
+
+def _graph(seed):
+    """Random graph whose edges are mostly near-diagonal (in-window) with a
+    deliberate far-block minority (remote tail) — test_fused_model.py's
+    workload, regenerated here so this file stands alone."""
+    rng = np.random.default_rng(seed)
+    rows, cols = [], []
+    for b in range(4):                       # <= 384 edges per 512-node block
+        r = rng.integers(b * BLOCK, (b + 1) * BLOCK, size=384)
+        near = rng.integers(max(0, (b - 1) * BLOCK),
+                            min(N_REAL, (b + 2) * BLOCK), size=384)
+        far_block = (b + 3) % 4              # outside the 3-block window
+        far = rng.integers(far_block * BLOCK, (far_block + 1) * BLOCK, size=384)
+        c = np.where(rng.uniform(size=384) < 0.1, far, near)
+        rows.append(r)
+        cols.append(c)
+    row = np.concatenate(rows)
+    col = np.concatenate(cols)
+    order = np.argsort(row, kind="stable")
+    ei = np.stack([row[order], col[order]]).astype(np.int64)
+    e = ei.shape[1]
+    return {
+        "node_feat": rng.normal(size=(N_REAL, 2)).astype(np.float32),
+        "loc": rng.uniform(0, 1, size=(N_REAL, 3)).astype(np.float32),
+        "vel": (rng.normal(size=(N_REAL, 3)) * 0.05).astype(np.float32),
+        "target": rng.uniform(0, 1, size=(N_REAL, 3)).astype(np.float32),
+        "edge_index": ei,
+        "edge_attr": rng.normal(size=(e, 2)).astype(np.float32),
+    }
+
+
+@pytest.fixture(scope="module")
+def batch():
+    gb = pad_graphs([_graph(0), _graph(1)], max_nodes=N_PAD, edge_block=BLOCK,
+                    edge_tile=BLOCK, edges_per_block=BLOCK, compute_pair=False,
+                    split_remote=True)
+    assert gb.remote_edge_mask is not None and gb.remote_edge_mask.sum() > 0
+    assert gb.max_nodes == N_PAD  # trailing all-padding node block present
+    return gb
+
+
+def _model(edge_impl, n_layers, **kw):
+    # gravity on: the megakernel's phi_g branch must be part of the parity
+    return FastEGNN(node_feat_nf=2, edge_attr_nf=2, hidden_nf=H,
+                    virtual_channels=2, n_layers=n_layers,
+                    edge_impl=edge_impl, gravity=(0.0, 0.0, -9.8), **kw)
+
+
+class _LazyParams:
+    """ONE init per depth, reused verbatim by both impls — the whole point of
+    the shared param tree (checkpoints swap impls without remapping). Lazy so
+    a tier-1 run that deselects the slow depths never pays their init."""
+
+    def __init__(self, batch):
+        self._batch, self._cache = batch, {}
+
+    def __getitem__(self, L):
+        if L not in self._cache:
+            self._cache[L] = jax.device_get(
+                _model("fused", L).init(jax.random.PRNGKey(0), self._batch))
+        return self._cache[L]
+
+
+@pytest.fixture(scope="module")
+def params_by_depth(batch):
+    return _LazyParams(batch)
+
+
+def _rel(a, b):
+    """max|a-b| / max|b| — the scale-normalized parity metric."""
+    d = float(np.abs(np.asarray(a) - np.asarray(b)).max())
+    s = float(np.abs(np.asarray(b)).max())
+    return d / max(s, 1e-30)
+
+
+def test_param_tree_shared_bitwise(batch):
+    """Checkpoint round-trip contract: a tree saved under edge_impl='fused'
+    restores into 'fused_stack' unchanged — same structure, same paths,
+    bitwise-identical values from the same seed."""
+    p_f = _model("fused", 2).init(jax.random.PRNGKey(0), batch)
+    p_s = _model("fused_stack", 2).init(jax.random.PRNGKey(0), batch)
+    assert (jax.tree_util.tree_structure(p_f)
+            == jax.tree_util.tree_structure(p_s))
+    flat_f, _ = ravel_pytree(p_f)
+    flat_s, _ = ravel_pytree(p_s)
+    assert bool(jnp.all(flat_f == flat_s))
+    # and the fused-initialized tree actually runs under fused_stack
+    x, X = _model("fused_stack", 2).apply(p_f, batch)
+    assert np.isfinite(np.asarray(x)).all() and np.isfinite(np.asarray(X)).all()
+
+
+@pytest.mark.parametrize("L", FWD_DEPTHS)
+def test_stack_forward_matches_fused(batch, params_by_depth, L):
+    p = params_by_depth[L]
+    x_f, X_f = _model("fused", L).apply(p, batch)
+    x_s, X_s = _model("fused_stack", L).apply(p, batch)
+    m = np.asarray(batch.node_mask)[..., None]
+    assert _rel(np.asarray(x_s) * m, np.asarray(x_f) * m) < 1e-6
+    assert _rel(X_s, X_f) < 1e-6
+
+
+@pytest.mark.parametrize("L", GRAD_DEPTHS)
+def test_stack_grads_match_fused(batch, params_by_depth, L):
+    p = params_by_depth[L]
+
+    def loss(impl, pp):
+        x, X = _model(impl, L).apply(pp, batch)
+        return (jnp.sum((x - batch.target) ** 2 * batch.node_mask[..., None])
+                + jnp.sum(X ** 2))
+
+    g_f, _ = ravel_pytree(jax.grad(lambda pp: loss("fused", pp))(p))
+    g_s, _ = ravel_pytree(jax.grad(lambda pp: loss("fused_stack", pp))(p))
+    assert _rel(g_s, g_f) < 1e-5
+
+
+def test_stack_full_train_step_matches_fused(batch, params_by_depth):
+    """One FULL train step (loss + grads + optimizer update) under
+    edge_impl='fused_stack', loss matching the per-layer fused step."""
+    p = params_by_depth[2]
+    tx = optax.adam(1e-3)
+    losses = {}
+    for impl in ("fused", "fused_stack"):
+        step = make_train_step(_model(impl, 2), tx, mmd_weight=0.0,
+                               mmd_sigma=1.5, mmd_samples=2)
+        state = TrainState.create(p, tx)
+        new_state, metrics = jax.jit(step)(state, batch, jax.random.PRNGKey(3))
+        assert int(new_state.step) == 1
+        assert np.isfinite(float(metrics["loss"]))
+        losses[impl] = float(metrics["loss"])
+    np.testing.assert_allclose(losses["fused_stack"], losses["fused"],
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_stack_requires_split_remote_batch(batch):
+    gb = batch.replace(remote_edge_index=None, remote_edge_attr=None,
+                       remote_edge_mask=None)
+    p = _model("fused_stack", 2).init(jax.random.PRNGKey(0), batch)
+    with pytest.raises(ValueError, match="split_remote"):
+        _model("fused_stack", 2).apply(p, gb)
+
+
+def test_vmem_budget_typed_error(batch, params_by_depth):
+    """An over-budget shape fails at trace time with the typed error, and the
+    message carries the numbers + the actionable fallback."""
+    model = _model("fused_stack", 2, stack_vmem_budget=1024)
+    with pytest.raises(StackVmemBudgetError, match="edge_impl='fused'"):
+        model.apply(params_by_depth[2], batch)
+
+
+def test_check_stack_vmem_bounds():
+    cfg = StackConfig(n_layers=4, block=512, hidden=64, channels=3,
+                      node_attr_nf=2, dtype_name="bf16")
+    # flagship shape exceeds the default budget BY DESIGN
+    with pytest.raises(StackVmemBudgetError) as ei:
+        check_stack_vmem(cfg, n_nodes=113_152, n_edges=1_639_424,
+                         remote_pad=8192)
+    msg = str(ei.value)
+    assert f"{DEFAULT_STACK_VMEM_BUDGET / 2**20:.1f} MiB" in msg
+    # the bench/serving cap shape fits the default budget
+    check_stack_vmem(cfg, n_nodes=1536, n_edges=19_968, remote_pad=128)
+
+
+def test_hbm_model_stack_beats_fused():
+    """The acceptance ratio: the analytic HBM-bytes-per-step model (the same
+    numbers scripts/microbench_ops.py prints) has fused_stack >= 1.3x less
+    traffic than per-layer fused at both the capped and flagship shapes."""
+    for n, e, rp in ((1536, 4608, 128), (113_152, 1_639_424, 8192)):
+        per = {impl: hbm_bytes_per_step(
+            impl, n_nodes=n, n_edges=e, hidden=64, channels=3, n_layers=4,
+            remote_pad=rp, node_attr_nf=2, dtype_name="bf16")["total"]
+            for impl in ("fused", "fused_stack")}
+        assert per["fused"] / per["fused_stack"] >= 1.3, (n, e, per)

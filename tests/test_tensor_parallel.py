@@ -64,7 +64,7 @@ def test_mesh_3d_shape_and_product_check():
 # ---------------------------------------------------------------- parity
 
 @needs_8
-@pytest.mark.parametrize("leg", ["plain", "fused"])
+@pytest.mark.parametrize("leg", ["plain", "fused", "fused_stack"])
 def test_tensor_parity_2x2x2_vs_2x2x1(leg):
     """fwd/grad/train-step within 1e-6 x max(1, scale) of the T=1 baseline —
     the dryrun parity harness, one edge layout per case."""

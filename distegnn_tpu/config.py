@@ -132,6 +132,12 @@ _DEFAULTS: dict = {
         # data_parallel, sharded over DATA_AXIS; devices used =
         # world_size * data_parallel (distegnn_tpu/parallel/mesh.py)
         "data_parallel": 1,
+        # input pipeline (data/stream.py): prefetch_depth batches produced
+        # ahead by a background thread (0 = synchronous blocking put);
+        # stream_shard_cache decoded shards resident per StreamedGraphDataset
+        # when a dataset path is a shard directory (scripts/shard_dataset.py)
+        "prefetch_depth": 2,
+        "stream_shard_cache": 4,
     },
     "train": {
         "learning_rate": 5e-4,
@@ -422,6 +428,10 @@ def validate_config(cfg: ConfigDict) -> None:
             raise ValueError("distribute mode requires data.outer_radius and data.inner_radius")
     if not 0.0 <= float(cfg.data.cutoff_rate) < 1.0:
         raise ValueError("data.cutoff_rate must be in [0, 1)")
+    if int(cfg.data.get("prefetch_depth", 2)) < 0:
+        raise ValueError("data.prefetch_depth must be >= 0 (0 = synchronous)")
+    if int(cfg.data.get("stream_shard_cache", 4)) < 1:
+        raise ValueError("data.stream_shard_cache must be >= 1")
     if cfg.train.accumulation_steps < 1:
         raise ValueError("train.accumulation_steps must be >= 1")
     resume = cfg.train.get("resume")

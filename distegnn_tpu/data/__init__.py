@@ -1,6 +1,6 @@
 """Data layer (L1+L2): offline n-body simulator, per-dataset preprocessing
-pipelines, and static-shape loaders (reference dataset_generation/** and
-datasets/process_dataset.py)."""
+pipelines, static-shape loaders, and the out-of-core streamed shard pipeline
+(reference dataset_generation/** and datasets/process_dataset.py)."""
 
 from distegnn_tpu.data.loader import GraphDataset, GraphLoader, ShardedGraphLoader
 from distegnn_tpu.data.nbody import build_nbody_graph, process_nbody_cutoff
@@ -9,14 +9,28 @@ from distegnn_tpu.data.nbody_sim import (
     generate_nbody_files,
     simulate_trajectory,
 )
+from distegnn_tpu.data.stream import (
+    PrefetchCrashError,
+    PrefetchLoader,
+    ShardChecksumError,
+    StreamedGraphDataset,
+    open_dataset,
+    write_shards,
+)
 
 __all__ = [
     "ChargedSystem",
     "GraphDataset",
     "GraphLoader",
+    "PrefetchCrashError",
+    "PrefetchLoader",
+    "ShardChecksumError",
     "ShardedGraphLoader",
+    "StreamedGraphDataset",
     "build_nbody_graph",
     "generate_nbody_files",
+    "open_dataset",
     "process_nbody_cutoff",
     "simulate_trajectory",
+    "write_shards",
 ]

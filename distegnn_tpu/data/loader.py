@@ -10,9 +10,12 @@ the TPU-first replacement for ragged PyG batching.
 
 from __future__ import annotations
 
+import contextlib
 import pickle
+import threading
 import time
-from typing import List, Optional, Sequence, Union
+import zipfile
+from typing import Callable, List, Optional, Sequence, Union
 
 import numpy as np
 import jax
@@ -21,21 +24,33 @@ from distegnn_tpu import obs
 from distegnn_tpu.ops.graph import GraphBatch, _round_up, pad_graphs
 
 # module-level open hook: the fault-injection harness (testing/faults.py
-# flaky_open) swaps this to exercise the retry path without touching a real
-# filesystem fault
+# flaky_open / truncated_read) swaps this to exercise the retry path without
+# touching a real filesystem fault
 _file_open = open
 
-# bounded retry around dataset file opens: epoch-start reads off NFS/GCS see
+# bounded retry around dataset file reads: epoch-start reads off NFS/GCS see
 # transient ESTALE/EIO-style hiccups, and a multi-hour unattended session
 # (scripts/convergence_session.sh) must not die to one
 _OPEN_ATTEMPTS = 3
 _OPEN_BACKOFF_S = 0.1
 
+# What a transiently-broken read surfaces as: open/read syscall errors
+# (OSError), a pickle cut mid-payload (EOFError / UnpicklingError), a
+# truncated .npz (BadZipFile), and numpy's header parse on garbage bytes
+# (ValueError). A file broken the same way on every attempt still fails
+# hard after the last retry.
+_READ_ERRORS = (OSError, EOFError, pickle.UnpicklingError,
+                zipfile.BadZipFile, ValueError)
+
 
 def _open_with_retry(path: str, mode: str = "rb"):
     """``open`` with ``_OPEN_ATTEMPTS`` tries and exponential backoff
     (0.1s, 0.2s, ...); each retry is logged. The final failure propagates —
-    a genuinely missing/unreadable file is still a hard error."""
+    a genuinely missing/unreadable file is still a hard error.
+
+    NOTE: this only guards the ``open()`` syscall. Dataset loads must use
+    :func:`_read_with_retry`, which covers the FULL payload read — a
+    truncated NFS read succeeds at open() and dies inside ``pickle.load``."""
     for attempt in range(_OPEN_ATTEMPTS):
         try:
             return _file_open(path, mode)
@@ -48,6 +63,74 @@ def _open_with_retry(path: str, mode: str = "rb"):
             time.sleep(delay)
 
 
+def _read_with_retry(path: str, reader: Callable, what: str = "dataset",
+                     retry_on: tuple = ()):
+    """Open ``path`` and run ``reader(file)`` with the bounded retry covering
+    the WHOLE read, not just ``open()``: a truncated NFS read hands back a
+    short payload that only explodes inside ``pickle.load``/``np.load``, and
+    before this existed such a failure escaped the retry and killed a
+    multi-hour convergence session. ``retry_on`` adds caller-typed errors
+    (e.g. a shard checksum mismatch) to the retryable set; the final failure
+    always propagates."""
+    errors = _READ_ERRORS + tuple(retry_on)
+    for attempt in range(_OPEN_ATTEMPTS):
+        try:
+            with _file_open(path, "rb") as f:
+                return reader(f)
+        except errors as e:
+            if attempt == _OPEN_ATTEMPTS - 1:
+                raise
+            delay = _OPEN_BACKOFF_S * (2 ** attempt)
+            obs.log(f"loader: {what} read {path} failed ({e!r}); retry "
+                    f"{attempt + 1}/{_OPEN_ATTEMPTS - 1} in {delay:.1f}s")
+            time.sleep(delay)
+
+
+# Stall attribution: the trainer reads per-step deltas of ``data/stall_s``,
+# so that counter must mean "time the TRAINER was blocked on data". When the
+# prefetch producer (data/stream.PrefetchLoader) drives a loader from its
+# background thread, the collate/put work overlaps compute and is NOT a
+# stall — the producer redirects its thread's accounting to
+# ``data/produce_s`` via this thread-local, and only the consumer's real
+# wait lands on ``data/stall_s``.
+_STALL_TLS = threading.local()
+
+
+def _stall_counter():
+    name = getattr(_STALL_TLS, "name", None) or "data/stall_s"
+    return obs.get_registry().counter(name)
+
+
+@contextlib.contextmanager
+def stall_attribution(name: str):
+    """Redirect this THREAD's loader stall accounting to ``name``."""
+    prev = getattr(_STALL_TLS, "name", None)
+    _STALL_TLS.name = name
+    try:
+        yield
+    finally:
+        _STALL_TLS.name = prev
+
+
+def graphs_nbytes(graphs: Sequence[dict]) -> int:
+    """Resident bytes of a list of graph dicts (numpy payload only)."""
+    total = 0
+    for g in graphs:
+        for v in g.values():
+            if isinstance(v, np.ndarray):
+                total += v.nbytes
+    return total
+
+
+def _log_host_bytes(nbytes: int, what: str) -> None:
+    """Account dataset host residency on the ``data/host_bytes`` gauge (the
+    RSS a training process pays to hold its datasets — the number the
+    out-of-core streamed loader exists to bound)."""
+    obs.get_registry().gauge("data/host_bytes").add(nbytes)
+    obs.log(f"loader: {what} resident {nbytes / 2**20:.1f} MiB "
+            f"(data/host_bytes)")
+
+
 class GraphDataset:
     """A list of graph dicts, from a processed pickle file or in memory
     (reference DatasetWrapper, datasets/process_dataset.py:582-596)."""
@@ -55,8 +138,15 @@ class GraphDataset:
     def __init__(self, source: Union[str, Sequence[dict]],
                  node_order: str = "none"):
         if isinstance(source, str):
-            with _open_with_retry(source, "rb") as f:
-                self.graphs: List[dict] = pickle.load(f)
+            # retry covers the FULL pickle read: a truncated NFS payload dies
+            # inside pickle.load, not at open()
+            self.graphs: List[dict] = _read_with_retry(
+                source, pickle.load, what="pickle")
+        elif isinstance(source, list):
+            # already-materialized list: adopt it as-is. list(source) here
+            # used to double the transient footprint of the outer container
+            # for zero benefit (the graph dicts were shared either way).
+            self.graphs = source
         else:
             self.graphs = list(source)
         # 'morton': relabel nodes along a Z curve of their positions — static
@@ -66,9 +156,18 @@ class GraphDataset:
         if node_order == "morton":
             from distegnn_tpu.ops.order import morton_reorder_graph
 
-            self.graphs = [morton_reorder_graph(g) for g in self.graphs]
+            if self.graphs is source:
+                # shallow outer copy (pointers only) so the caller's list is
+                # never mutated by the per-slot reorder below
+                self.graphs = list(self.graphs)
+            # per-slot replacement so peak payload residency stays one
+            # dataset + one graph, not two full array sets
+            for i in range(len(self.graphs)):
+                self.graphs[i] = morton_reorder_graph(self.graphs[i])
         elif node_order not in ("none", None):
             raise ValueError(f"GraphDataset: unknown node_order {node_order!r}")
+        _log_host_bytes(graphs_nbytes(self.graphs),
+                        f"GraphDataset[{len(self.graphs)} graphs]")
 
     def __len__(self) -> int:
         return len(self.graphs)
@@ -235,9 +334,10 @@ class GraphLoader:
     def __iter__(self):
         order = self._order()
         # collation time is data-stall by definition (iteration is
-        # synchronous: the trainer blocks on this generator), recorded into
-        # the global registry so step events / obs_report can attribute it
-        stall = obs.get_registry().counter("data/stall_s")
+        # synchronous: the trainer blocks on this generator) — unless this
+        # thread runs under stall_attribution (prefetch producer), in which
+        # case the same work overlaps compute and lands on data/produce_s
+        stall = _stall_counter()
         for b in range(len(self)):
             t0 = time.perf_counter()
             idx = order[b * self.batch_size:(b + 1) * self.batch_size]
@@ -350,8 +450,9 @@ class ShardedGraphLoader:
     def __iter__(self):
         D = self.data_parallel
         # the per-shard loaders already count their collation time; only the
-        # stack/reshape work on top of them is added here
-        stall = obs.get_registry().counter("data/stall_s")
+        # stack/reshape work on top of them is added here (same thread-local
+        # attribution as GraphLoader.__iter__)
+        stall = _stall_counter()
         for parts in zip(*self.loaders):
             t0 = time.perf_counter()
             if any(p.edge_pair is None for p in parts):

@@ -31,7 +31,6 @@ import numpy as np
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from distegnn_tpu import obs
-from distegnn_tpu.obs.jaxprobe import TransferMeter
 from distegnn_tpu.parallel.compat import shard_map
 from distegnn_tpu.parallel.mesh import DATA_AXIS, GRAPH_AXIS, TENSOR_AXIS, make_mesh
 from distegnn_tpu.train import (
@@ -138,33 +137,9 @@ def global_batch_putter(mesh):
     return put
 
 
-class _PuttingLoader:
-    """Wrap a loader so every yielded batch goes through global_batch_putter.
-
-    The put is part of the data stall by definition (the trainer blocks on
-    this generator), so its time joins the loader's ``data/stall_s`` counter;
-    the batch bytes feed the ``xfer/h2d_bytes`` transfer meter."""
-
-    def __init__(self, loader, put):
-        self.loader, self.put = loader, put
-        self._meter = TransferMeter()
-
-    def set_epoch(self, epoch):
-        self.loader.set_epoch(epoch)
-
-    def __len__(self):
-        return len(self.loader)
-
-    def __iter__(self):
-        import time as _time
-
-        stall = obs.get_registry().counter("data/stall_s")
-        for batch in self.loader:
-            t0 = _time.perf_counter()
-            self._meter.h2d(batch)
-            out = self.put(batch)
-            stall.add(_time.perf_counter() - t0)
-            yield out
+# the blocking put-wrapper (_PuttingLoader) lives on as
+# data/stream.PrefetchLoader(depth=0); depth>0 (config data.prefetch_depth,
+# default 2) overlaps collate + put with the previous step's compute
 
 
 def _dispatch_preprocess(config, ws: int):
@@ -209,7 +184,7 @@ def run_distributed(config):
     shards -> ShardedGraphLoader -> shard_map'd jitted step -> shared outer
     training loop."""
     from distegnn_tpu.config import derive_runtime_fields
-    from distegnn_tpu.data import GraphDataset, ShardedGraphLoader
+    from distegnn_tpu.data import PrefetchLoader, ShardedGraphLoader, open_dataset
     from distegnn_tpu.models.registry import get_model
     from distegnn_tpu.utils.seed import fix_seed
 
@@ -260,8 +235,12 @@ def run_distributed(config):
     put = global_batch_putter(mesh)
     loaders = []
     for split_idx, paths in enumerate(split_paths):
-        datasets = [GraphDataset(p, node_order=d.node_order) for p in paths]
-        loaders.append(_PuttingLoader(ShardedGraphLoader(
+        # open_dataset streams shard directories (scripts/shard_dataset.py
+        # output) out-of-core and materializes pickle paths as before
+        datasets = [open_dataset(p, node_order=d.node_order,
+                                 cache_shards=int(d.get("stream_shard_cache", 4)))
+                    for p in paths]
+        loaders.append(PrefetchLoader(ShardedGraphLoader(
             datasets, d.batch_size, shuffle=(split_idx == 0), seed=config.seed,
             node_bucket=d.node_bucket, edge_bucket=d.edge_bucket,
             data_parallel=dp, edge_block=d.edge_block,
@@ -272,7 +251,7 @@ def run_distributed(config):
             pairing=(True if (not d.edge_block and
                               config.model.get("segment_impl") in ("cumsum", "ell"))
                      else None),
-        ), put))
+        ), put, depth=int(d.get("prefetch_depth", 2))))
     loader_train, loader_valid, loader_test = loaders
     obs.log(f"Data ready: {len(loader_train.loader.loaders[0].dataset)} graphs x "
             f"{ws} partitions x {dp} data shards")

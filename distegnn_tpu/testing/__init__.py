@@ -13,6 +13,7 @@ from distegnn_tpu.testing.faults import (
     inject_at_call,
     poison_nan_batches,
     simulate_killed_save,
+    truncated_read,
 )
 from distegnn_tpu.testing.serve_faults import (
     corrupt_swap_checkpoint,
@@ -29,6 +30,7 @@ __all__ = [
     "simulate_killed_save",
     "poison_nan_batches",
     "flaky_open",
+    "truncated_read",
     "inject_at_call",
     "kill_replica",
     "kill9_replica",

@@ -130,6 +130,36 @@ def flaky_open(fail_times: int, exc: Optional[OSError] = None):
         loader_mod._file_open = real
 
 
+@contextlib.contextmanager
+def truncated_read(fail_times: int, fraction: float = 0.5):
+    """Patch the loader's open hook so the first ``fail_times`` opened files
+    hand back only the leading ``fraction`` of their bytes — the torn-NFS
+    shape where ``open()`` SUCCEEDS and the failure only surfaces inside the
+    payload read (``pickle.load`` EOFError, npz BadZipFile, shard CRC
+    mismatch). Exercises the full-read retry (`loader._read_with_retry`)
+    that a plain open-retry cannot cover. Yields the observed call count."""
+    import io
+
+    from distegnn_tpu.data import loader as loader_mod
+
+    calls = {"n": 0}
+    real = loader_mod._file_open
+
+    def _open(path, mode="rb"):
+        calls["n"] += 1
+        if calls["n"] <= fail_times:
+            with real(path, "rb") as f:
+                data = f.read()
+            return io.BytesIO(data[:int(len(data) * fraction)])
+        return real(path, mode)
+
+    loader_mod._file_open = _open
+    try:
+        yield calls
+    finally:
+        loader_mod._file_open = real
+
+
 # ---- process faults --------------------------------------------------------
 
 def inject_at_call(step: Callable, n: int, action: Callable[[], None]) -> Callable:

@@ -339,11 +339,29 @@ EOF
   cp /tmp/bench_mesh3d_last.json \
      "docs/artifacts/bench_mesh3d_$(date -u +%Y%m%dT%H%M%S).json"
 }
+# 0c. input-pipeline leg (data/stream.py): streamed-shard prefetch vs
+#     blocking put, graphs/s + data/stall_s fractions on THIS host's disk.
+#     The check requires the prefetch stall to not exceed the blocking stall
+#     — the direct acceptance evidence for the out-of-core pipeline.
+io_leg_and_check() {
+  python bench.py --layout io | tee /tmp/bench_io_last.json
+  python - <<'EOF' || return 1
+import json
+line = [l for l in open('/tmp/bench_io_last.json') if l.strip().startswith('{')][-1]
+rec = json.loads(line)
+raise SystemExit(0 if rec['value'] > 0
+                 and rec['stall_s'] <= rec['stall_s_blocking'] else 1)
+EOF
+  mkdir -p docs/artifacts
+  cp /tmp/bench_io_last.json \
+     "docs/artifacts/bench_io_$(date -u +%Y%m%dT%H%M%S).json"
+}
 export -f mesh3d_leg_and_check fused_leg_and_check stack_leg_and_check \
-          bench_and_check  # run_bounded's bash -c needs them
+          io_leg_and_check bench_and_check  # run_bounded's bash -c needs them
 run_bounded bench_fused fused_leg_and_check
 run_bounded bench_fused_stack stack_leg_and_check
 run_bounded bench_mesh3d mesh3d_leg_and_check
+run_bounded bench_io io_leg_and_check
 # 1. headline bench: auto races fused / plain-cumsum stacks / plain-scatter
 #    anchor in child processes (bench.RACE_ORDER) and reports the fastest
 #    real measurement

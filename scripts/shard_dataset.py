@@ -1,0 +1,58 @@
+#!/usr/bin/env python
+"""Convert a processed pickle dataset (list of graph dicts) into the
+out-of-core shard directory consumed by StreamedGraphDataset
+(distegnn_tpu/data/stream.py): fixed-schema .npz shards + manifest.json with
+per-shard maxima and CRC32 checksums.
+
+Usage:
+  python scripts/shard_dataset.py --input processed.pkl --out shards_dir \
+      [--shard-size 64] [--node-order none|morton]
+
+Point config.data paths at the output directory and launch.py streams it
+instead of materializing the pickle (see docs/PERFORMANCE.md "Input
+pipeline").
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import pickle
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--input", required=True,
+                    help="processed dataset pickle (list of graph dicts)")
+    ap.add_argument("--out", required=True, help="output shard directory")
+    ap.add_argument("--shard-size", type=int, default=64,
+                    help="graphs per shard (default 64)")
+    ap.add_argument("--node-order", default="none", choices=["none", "morton"],
+                    help="bake a node relabeling into the shards (morton: "
+                         "Z-curve locality, ops/order.py)")
+    args = ap.parse_args(argv)
+
+    from distegnn_tpu.data.stream import write_shards
+
+    with open(args.input, "rb") as f:
+        graphs = pickle.load(f)
+    manifest = write_shards(graphs, args.out, shard_size=args.shard_size,
+                            node_order=args.node_order)
+    print(json.dumps({
+        "out": args.out,
+        "n_graphs": manifest["n_graphs"],
+        "n_shards": len(manifest["shards"]),
+        "shard_size": manifest["shard_size"],
+        "max_nodes": manifest["max_nodes"],
+        "max_edges": manifest["max_edges"],
+        "bytes": sum(s["bytes"] for s in manifest["shards"]),
+    }))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -40,6 +40,10 @@ def pytest_configure(config):
     # full matrix (chaos drill, swap-under-workers) is additionally `slow`.
     config.addinivalue_line(
         "markers", "process: spawns serving worker child processes")
+    # io tests exercise the out-of-core streamed pipeline (data/stream.py);
+    # the full-epoch blocked-layout parity sweep is additionally `slow`
+    config.addinivalue_line(
+        "markers", "io: input-pipeline tests (sharded datasets, prefetch)")
 
 
 @pytest.fixture(autouse=True)

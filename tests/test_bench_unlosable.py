@@ -54,7 +54,14 @@ def test_every_race_leg_traces_on_cpu(child_args, child_env):
         capture_output=True, text=True, timeout=600, cwd=REPO, env=env)
     assert out.returncode == 0, f"leg {child_args} died: {out.stderr[-800:]}"
     rec = json.loads(out.stdout.strip().splitlines()[-1])
-    assert rec["value"] > 0 and "nodes/sec" in rec["unit"]
+    assert rec["value"] > 0
+    if rec["metric"] == "io_pipeline_graphs_per_sec":
+        # input-pipeline leg: graphs/s + the stall A/B fields, not nodes/sec
+        assert "graphs/s" in rec["unit"]
+        assert rec["stall_s_blocking"] >= 0 and rec["stall_s"] >= 0
+        assert rec["vs_blocking"] > 0
+    else:
+        assert "nodes/sec" in rec["unit"]
 
 
 def test_serve_bench_rollout_leg_traces_on_cpu(capsys):

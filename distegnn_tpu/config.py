@@ -242,6 +242,59 @@ _DEFAULTS: dict = {
             "breaker_cooldown_s": 30.0,
             "healthy_reset_s": 60.0,
         },
+        # SLO-driven replica autoscaler (serve/autoscale.py): a per-model
+        # control loop over the windowed SLO gauges (queue depth, shed rate,
+        # p99) that grows/shrinks the ReplicaSet live. Disabled by default —
+        # a static fleet stays exactly as configured.
+        "autoscale": {
+            "enable": False,
+            "min_replicas": 1,
+            "max_replicas": 4,
+            # control-loop cadence and per-direction cooldowns (a scale
+            # action suppresses further actions in the SAME direction for
+            # its cooldown; up may still interrupt a down-calm streak)
+            "interval_s": 0.5,
+            "scale_up_cooldown_s": 2.0,
+            "scale_down_cooldown_s": 10.0,
+            # replicas added/retired per decision
+            "step": 1,
+            # scale-up triggers: queued requests per healthy replica, window
+            # shed-rate fraction, optional absolute predict-p99 ceiling (ms,
+            # null = p99 does not trigger)
+            "queue_high": 4.0,
+            "shed_high": 0.01,
+            "p99_high_ms": None,
+            # scale-down gate: per-replica depth below queue_low AND zero
+            # window shed for idle_rounds consecutive evaluations
+            "queue_low": 0.5,
+            "idle_rounds": 3,
+            # drain budget when retiring a replica (in-flight work finishes
+            # before the queue stops — at-most-once is never sacrificed)
+            "drain_timeout_s": 30.0,
+        },
+        # priority admission (serve/transport.py): interactive predicts
+        # outrank bulk rollouts when the gateway saturates. Bulk work only
+        # uses up to bulk_max_inflight_frac of the inflight budget, and is
+        # deferred outright while the SLO window is degraded (shed rate
+        # past degrade_shed_rate, or predict p99 past degrade_p99_ms).
+        # Clients override the class with the priority header.
+        "priority": {
+            "enable": True,
+            "header": "X-Priority",
+            "bulk_max_inflight_frac": 0.75,
+            "degrade_shed_rate": 0.05,
+            "degrade_p99_ms": None,
+            # Retry-After multiplier for deferred/shed bulk requests
+            "bulk_retry_factor": 4.0,
+        },
+        # chunked streaming rollouts (POST .../rollout?stream=1): the steps
+        # axis executes as successive chunk_steps-length compiled scans with
+        # the carry threaded between, so the first chunk arrives after
+        # ~chunk_steps/K of the work and a client disconnect cancels the
+        # remaining chunks. Non-streaming requests are untouched.
+        "stream": {
+            "chunk_steps": 8,
+        },
         # multi-model routing (serve/registry.py): null = one model from
         # THIS config; else a list of {name, config_path?, overrides?}
         # entries, each owning its own engine + queue + warmup
@@ -616,6 +669,77 @@ def validate_config(cfg: ConfigDict) -> None:
                 raise ValueError(f"serve.supervisor.{key} must be > 0")
         if int(sup.get("breaker_threshold", 3)) < 1:
             raise ValueError("serve.supervisor.breaker_threshold must be >= 1")
+    a = s.get("autoscale")
+    if a is not None:
+        if not isinstance(a, Mapping):
+            raise ValueError("serve.autoscale must be null or a mapping of "
+                             "ReplicaAutoscaler knobs")
+        aknown = ("enable", "min_replicas", "max_replicas", "interval_s",
+                  "scale_up_cooldown_s", "scale_down_cooldown_s", "step",
+                  "queue_high", "shed_high", "p99_high_ms", "queue_low",
+                  "idle_rounds", "drain_timeout_s")
+        for key in a:
+            if key not in aknown:
+                raise ValueError(f"serve.autoscale: unknown key {key!r} "
+                                 f"(accepted: {', '.join(aknown)})")
+        if not isinstance(a.get("enable", False), bool):
+            raise ValueError("serve.autoscale.enable must be a boolean")
+        lo = int(a.get("min_replicas", 1))
+        hi = int(a.get("max_replicas", 4))
+        if lo < 1 or hi < lo:
+            raise ValueError("serve.autoscale needs 1 <= min_replicas "
+                             "<= max_replicas")
+        if int(a.get("step", 1)) < 1 or int(a.get("idle_rounds", 3)) < 1:
+            raise ValueError("serve.autoscale.step and "
+                             "serve.autoscale.idle_rounds must be >= 1")
+        for key in ("interval_s", "drain_timeout_s", "queue_high"):
+            if float(a.get(key, 1.0)) <= 0:
+                raise ValueError(f"serve.autoscale.{key} must be > 0")
+        for key in ("scale_up_cooldown_s", "scale_down_cooldown_s",
+                    "shed_high", "queue_low"):
+            if float(a.get(key, 0.0)) < 0:
+                raise ValueError(f"serve.autoscale.{key} must be >= 0")
+        if a.get("p99_high_ms") is not None and float(a["p99_high_ms"]) <= 0:
+            raise ValueError("serve.autoscale.p99_high_ms must be null "
+                             "or > 0")
+    p = s.get("priority")
+    if p is not None:
+        if not isinstance(p, Mapping):
+            raise ValueError("serve.priority must be null or a mapping of "
+                             "priority-admission knobs")
+        pknown = ("enable", "header", "bulk_max_inflight_frac",
+                  "degrade_shed_rate", "degrade_p99_ms", "bulk_retry_factor")
+        for key in p:
+            if key not in pknown:
+                raise ValueError(f"serve.priority: unknown key {key!r} "
+                                 f"(accepted: {', '.join(pknown)})")
+        if not isinstance(p.get("enable", True), bool):
+            raise ValueError("serve.priority.enable must be a boolean")
+        if not str(p.get("header", "X-Priority")).strip():
+            raise ValueError("serve.priority.header must be non-empty")
+        frac = float(p.get("bulk_max_inflight_frac", 0.75))
+        if not 0.0 < frac <= 1.0:
+            raise ValueError("serve.priority.bulk_max_inflight_frac must be "
+                             "in (0, 1]")
+        if float(p.get("degrade_shed_rate", 0.05)) < 0:
+            raise ValueError("serve.priority.degrade_shed_rate must be >= 0")
+        if (p.get("degrade_p99_ms") is not None
+                and float(p["degrade_p99_ms"]) <= 0):
+            raise ValueError("serve.priority.degrade_p99_ms must be null "
+                             "or > 0")
+        if float(p.get("bulk_retry_factor", 4.0)) < 1:
+            raise ValueError("serve.priority.bulk_retry_factor must be >= 1")
+    st = s.get("stream")
+    if st is not None:
+        if not isinstance(st, Mapping):
+            raise ValueError("serve.stream must be null or a mapping of "
+                             "streaming-rollout knobs")
+        for key in st:
+            if key not in ("chunk_steps",):
+                raise ValueError(f"serve.stream: unknown key {key!r} "
+                                 f"(accepted: chunk_steps)")
+        if int(st.get("chunk_steps", 8)) < 1:
+            raise ValueError("serve.stream.chunk_steps must be >= 1")
     models = s.get("models")
     if models is not None:
         if not isinstance(models, (list, tuple)) or not models:

@@ -387,13 +387,42 @@ class SLOMonitor:
             slots = float(entry.engine.metrics.batch_slots_total)
             with self._lock:
                 dq = self._fills.setdefault(name, deque())
+                if dq and (filled < dq[-1][1] or slots < dq[-1][2]):
+                    # a replica restart reset the cumulative counters — the
+                    # old samples can't be differenced against the new line;
+                    # restart the window baseline at the reset point
+                    dq.clear()
                 dq.append((t, filled, slots))
                 if len(dq) > self.max_samples:
                     dq.popleft()
                 t0, f0, s0 = dq[0]
             if slots > s0:
                 registry.gauge(f"slo/window_model_{name}_fill").set(
-                    (filled - f0) / (slots - s0))
+                    max((filled - f0) / (slots - s0), 0.0))
+
+
+    def window_snapshot(self, now: Optional[float] = None
+                        ) -> Dict[str, float]:
+        """The window as a flat stats dict (same vocabulary the offline
+        verdict speaks, ``window_requests`` added) — the shared input for
+        the autoscaler and priority admission, so scale/shed decisions read
+        exactly the numbers ``/metrics`` exports."""
+        t = time.monotonic() if now is None else now
+        with self._lock:
+            self._prune(t)
+            samples = list(self._http)
+        out: Dict[str, float] = {"window_requests": float(len(samples))}
+        for route in SLO_ROUTES:
+            lat = sorted(ms for (_, r, ms, s) in samples
+                         if r == route and s < 400)
+            if lat:
+                out[f"{route}_p50_ms"] = percentile(lat, 50)
+                out[f"{route}_p99_ms"] = percentile(lat, 99)
+        if samples:
+            statuses = [s for (_, _, _, s) in samples]
+            out["error_rate"] = sum(s >= 500 for s in statuses) / len(statuses)
+            out["shed_rate"] = sum(s == 429 for s in statuses) / len(statuses)
+        return out
 
 
 def bench_verdict(spec: SLOSpec, stats: Dict[str, float]) -> Dict[str, Any]:

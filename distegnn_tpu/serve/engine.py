@@ -433,3 +433,71 @@ class InferenceEngine:
                 f"(scene, steps): {bad}; raise max_degree/max_per_cell in "
                 f"rollout_opts")
         return [traj[i, :, :n].copy() for i, n in enumerate(ns)]
+
+    def rollout_stream(self, scene: dict, emit,
+                       request_id: Optional[str] = None) -> dict:
+        """Chunked K-step rollout of ONE scene, delivering the trajectory
+        incrementally through ``emit`` (a :class:`~distegnn_tpu.serve.queue.
+        StreamSink`-shaped object: ``put_chunk(start_step, traj)`` plus a
+        ``cancelled`` flag polled between chunks).
+
+        The steps axis is executed as successive ``chunk_steps``-length
+        compiled scans with the (loc, vel) carry threaded between them
+        host-side — the same per-step update as one long scan (the carry
+        rule mirrors rollout.py: ``v_next = (x_next - x) * velocity_scale``
+        when ``velocity_from_delta``), so the first chunk arrives after
+        ~chunk/K of the work and a client disconnect stops the remaining
+        compute at the next chunk boundary. The compile-cache key is the
+        single-scene ``("rollout", n_pad, chunk)`` rung, shared with the
+        unbatched path. Returns a summary dict (steps_total / steps_done /
+        cancelled / chunk_steps)."""
+        from distegnn_tpu.rollout import make_rollout_fn
+
+        steps = int(scene["steps"])
+        chunk = max(1, int(scene.get("chunk_steps", 8) or 8))
+        n = int(scene["loc"].shape[0])
+        n_pad = self.rollout_rung(n)
+        opts = self._rollout_fn_opts()
+        vel_from_delta = bool(opts.get("velocity_from_delta", True))
+        vscale = float(opts.get("velocity_scale", 1.0))
+        loc_p = np.zeros((n_pad, 3), np.float32)
+        vel_p = np.zeros((n_pad, 3), np.float32)
+        mask = np.zeros((n_pad,), np.float32)
+        loc_p[:n], vel_p[:n] = scene["loc"], scene["vel"]
+        nm = scene.get("node_mask")
+        mask[:n] = (nm if nm is not None else np.ones(n)).astype(np.float32)
+
+        done = 0
+        while done < steps:
+            if getattr(emit, "cancelled", False):
+                break
+            c = min(chunk, steps - done)
+
+            def build(_c=c):
+                ro = make_rollout_fn(self.model, **opts)
+                return jax.jit(functools.partial(ro, steps=_c))
+
+            fn = self._compiled(("rollout", n_pad, c) + self._stack_key,
+                                build)
+            with obs.span("serve/execute", n=n_pad, e=0, filled=1,
+                          capacity=1, workload="rollout_stream", steps=c,
+                          **_rid_attrs([request_id])):
+                traj, over = fn(self.params, jnp.asarray(loc_p),
+                                jnp.asarray(vel_p), jnp.asarray(mask))
+                traj = np.asarray(traj)                  # [c, n_pad, 3]
+            if bool(np.asarray(over).any()):
+                self.metrics.failed()
+                raise RolloutOverflowError(
+                    f"streamed rollout overflowed radius-graph capacity at "
+                    f"steps {(done + np.nonzero(np.asarray(over))[0]).tolist()}"
+                    f"; raise max_degree/max_per_cell in rollout_opts")
+            # thread the carry exactly as the scan body would have
+            prev = loc_p if c == 1 else traj[c - 2]
+            new_loc = traj[c - 1].copy()
+            if vel_from_delta:
+                vel_p = ((new_loc - prev) * vscale).astype(np.float32)
+            loc_p = new_loc
+            emit.put_chunk(done, traj[:, :n].copy())
+            done += c
+        return {"steps_total": steps, "steps_done": done,
+                "cancelled": done < steps, "chunk_steps": chunk}

@@ -162,22 +162,62 @@ class ServeFuture:
         return self._result
 
 
+class StreamSink:
+    """Chunk conduit between the dispatcher (producer) and a streaming
+    consumer (the gateway's chunked-transfer writer) for ONE rollout.
+
+    The producer calls ``put_chunk`` per chunk, then exactly one of
+    ``finish`` / ``fail``; the consumer iterates ``next`` and calls
+    ``cancel()`` when its client disconnects — the producer polls
+    ``cancelled`` between chunk computations and stops, so remaining
+    compute is skipped at the next chunk boundary. Thread-safe; items are
+    ``("chunk", start_step, traj)``, ``("done", summary, None)``, or
+    ``("error", exc, None)``."""
+
+    def __init__(self):
+        self._q: "_pyqueue.Queue" = _pyqueue.Queue()
+        self._cancelled = threading.Event()
+
+    def put_chunk(self, start_step: int, traj) -> None:
+        self._q.put(("chunk", int(start_step), traj))
+
+    def finish(self, summary: dict) -> None:
+        self._q.put(("done", summary, None))
+
+    def fail(self, exc: BaseException) -> None:
+        self._q.put(("error", exc, None))
+
+    def cancel(self) -> None:
+        self._cancelled.set()
+
+    @property
+    def cancelled(self) -> bool:
+        return self._cancelled.is_set()
+
+    def next(self, timeout: Optional[float] = None):
+        """Blocking pop of the next item; raises ``queue.Empty`` on
+        timeout (the consumer's poll loop re-checks the future then)."""
+        return self._q.get(timeout=timeout)
+
+
 class _Request:
     __slots__ = ("graph", "bucket", "kind", "steps", "future", "t_submit",
-                 "deadline", "request_id")
+                 "deadline", "request_id", "stream")
 
     def __init__(self, graph: dict, bucket: Bucket, deadline: float,
                  hard_deadline: Optional[float] = None,
                  kind: str = "predict", steps: Optional[int] = None,
-                 request_id: Optional[str] = None):
+                 request_id: Optional[str] = None,
+                 stream: Optional[StreamSink] = None):
         self.graph = graph
         self.bucket = bucket
-        self.kind = kind        # "predict" | "rollout"
+        self.kind = kind        # "predict" | "rollout" | "rollout_stream"
         self.steps = steps      # rollout scan length (None for predicts)
         self.future = ServeFuture(hard_deadline=hard_deadline)
         self.t_submit = time.perf_counter()
         self.deadline = deadline
         self.request_id = request_id  # gateway trace id (None off-gateway)
+        self.stream = stream    # StreamSink for kind "rollout_stream"
 
     @property
     def key(self):
@@ -346,13 +386,21 @@ class RequestQueue:
         return self._enqueue(req)
 
     def submit_rollout(self, scene: dict,
-                       request_id: Optional[str] = None) -> ServeFuture:
+                       request_id: Optional[str] = None,
+                       stream: Optional[StreamSink] = None) -> ServeFuture:
         """Admit one rollout scene dict (``loc`` [n,3], ``vel`` [n,3],
         ``steps`` int, optional ``node_mask``); resolves to the trajectory
         [steps, n, 3]. Same deadline/backpressure semantics as ``submit`` —
         rollouts share the ingress, deadlines, and restart containment; they
         coalesce per (node rung, steps), so same-shape same-K scenes fill one
-        compiled scan exactly like predicts fill a padded batch."""
+        compiled scan exactly like predicts fill a padded batch.
+
+        With ``stream`` (a :class:`StreamSink`), the scene runs as a CHUNKED
+        stream instead: the trajectory arrives on the sink chunk by chunk
+        (``engine.rollout_stream``), the future resolves to the run summary,
+        and a ``stream.cancel()`` stops the remaining chunks. Streams never
+        co-batch with buffered rollouts and never enter the solo-retry path
+        after partial emission — a failed chunk fails the sink, once."""
         if not self._started:
             raise RuntimeError("RequestQueue not started (use start() or a "
                                "with-block)")
@@ -365,7 +413,8 @@ class RequestQueue:
                        deadline=now + self.request_timeout,
                        hard_deadline=(now + self.request_timeout
                                       + self.result_margin),
-                       kind="rollout", steps=steps, request_id=request_id)
+                       kind="rollout" if stream is None else "rollout_stream",
+                       steps=steps, request_id=request_id, stream=stream)
         return self._enqueue(req)
 
     def _enqueue(self, req: _Request) -> ServeFuture:
@@ -533,9 +582,12 @@ class RequestQueue:
         for r in reqs:
             if r.deadline <= now:
                 self.metrics.timed_out()
-                r.future.set_exception(RequestTimeoutError(
+                exc = RequestTimeoutError(
                     f"request waited > {self.request_timeout * 1e3:.0f} ms "
-                    f"in bucket {key[1]}"))
+                    f"in bucket {key[1]}")
+                r.future.set_exception(exc)
+                if r.stream is not None:
+                    r.stream.fail(exc)
         reqs[:] = alive
 
     def _run_batch(self, key, reqs: List[_Request]) -> List:
@@ -543,10 +595,38 @@ class RequestQueue:
         kind, bucket, _steps = key
         graphs = [r.graph for r in reqs]
         rids = _request_ids(reqs)
+        if kind == "rollout_stream":
+            return [self._run_stream(r) for r in reqs]
         if kind == "rollout":
             return self.engine.rollout_batch(graphs, request_ids=rids)
         return self.engine.predict_batch(graphs, bucket=bucket,
                                          request_ids=rids)
+
+    def _run_stream(self, r: _Request) -> dict:
+        """Execute ONE streamed rollout scene. Exceptions stay inside: a
+        failed chunk fails the request's sink and future directly — a
+        partially-emitted stream must never re-run through the solo-retry
+        path (the client already consumed its prefix)."""
+        sink = r.stream
+        try:
+            summary = self.engine.rollout_stream(r.graph, sink,
+                                                 request_id=r.request_id)
+        except Exception as exc:
+            self.metrics.failed()
+            sink.fail(exc)
+            r.future.set_exception(exc)
+            return {"error": repr(exc)}
+        if summary.get("cancelled"):
+            # client went away mid-stream: the remaining steps were skipped
+            # at the chunk boundary — the freed-compute audit trail
+            obs.event("serve/stream_cancelled",
+                      request_id=r.request_id,
+                      steps_done=summary["steps_done"],
+                      steps_total=summary["steps_total"],
+                      steps_skipped=(summary["steps_total"]
+                                     - summary["steps_done"]))
+        sink.finish(summary)
+        return summary
 
     def _execute(self, key, reqs: List[_Request]) -> None:
         kind, bucket, steps = key
@@ -623,6 +703,8 @@ class RequestQueue:
         for reqs in list(self._pending.values()):
             for r in list(reqs):
                 r.future.set_exception(exc)
+                if r.stream is not None:
+                    r.stream.fail(exc)
         self._pending.clear()
         while True:
             try:
@@ -633,3 +715,5 @@ class RequestQueue:
                 continue
             if not (isinstance(item, tuple) and item[0] is _STOP):
                 item.future.set_exception(exc)
+                if item.stream is not None:
+                    item.stream.fail(exc)

@@ -87,6 +87,12 @@ class ModelEntry:
         self.checkpoint: Optional[str] = None
         self.params_version = 0
         self._swap_lock = threading.Lock()
+        # ``replica_factory(idx) -> Replica``: the autoscaler's scale-up
+        # recipe, set by _build_entry (None for hand-built entries — those
+        # sets are not elastically growable). Reads the entry's CURRENT
+        # params/checkpoint at call time, so replicas added after a
+        # blue/green swap come up on the live version.
+        self.replica_factory = None
 
     @property
     def queue(self) -> ReplicaSet:
@@ -324,6 +330,29 @@ class ModelRegistry:
                                supervisor_opts=supervisor_opts)
         if ckpt:
             entry.checkpoint = str(ckpt)
+        if backend == "process":
+            def replica_factory(idx, _entry=entry, _queue_kw=queue_kw,
+                                _worker_opts=worker_opts, _cfg_dict=cfg_dict,
+                                _fallback=fallback_factory):
+                return WorkerReplica(
+                    idx, _entry.engine, model=_entry.name,
+                    queue_kw=_queue_kw, worker_opts=_worker_opts,
+                    cfg_dict=_cfg_dict, fallback_factory=_fallback,
+                    checkpoint=_entry.checkpoint)
+        else:
+            def replica_factory(idx, _cfg=cfg, _model=model, _entry=entry,
+                                _metrics=metrics):
+                from distegnn_tpu.serve.replica import Replica
+
+                # fresh engine + queue serving the entry's CURRENT params
+                # (post-swap correct), sharing the primary's prep cache so
+                # failed-over sessions keep their hit rate
+                eng_i, q_i = engine_from_config(_cfg, _model,
+                                                params=_entry.engine.params,
+                                                metrics=_metrics)
+                eng_i.prep_cache = _entry.engine.prep_cache
+                return Replica(idx, eng_i, q_i)
+        entry.replica_factory = replica_factory
         return entry
 
     @classmethod

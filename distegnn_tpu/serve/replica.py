@@ -65,16 +65,18 @@ class _Tracked:
     """One admitted request: the outer future handed to the caller plus
     everything needed to re-dispatch it to a survivor."""
 
-    __slots__ = ("kind", "payload", "bucket", "request_id", "outer", "tried")
+    __slots__ = ("kind", "payload", "bucket", "request_id", "outer", "tried",
+                 "stream")
 
     def __init__(self, kind: str, payload: dict, bucket, request_id,
-                 outer: ServeFuture):
+                 outer: ServeFuture, stream=None):
         self.kind = kind            # "predict" | "rollout"
         self.payload = payload
         self.bucket = bucket        # predict-only override (may be None)
         self.request_id = request_id
         self.outer = outer
         self.tried: Set[int] = set()  # replica indices that saw this request
+        self.stream = stream        # StreamSink: streamed rollouts only
 
 
 class Replica:
@@ -242,6 +244,19 @@ class WorkerQueue(RequestQueue):
 
     def _run_batch(self, key, reqs) -> List:
         kind, bucket, _steps = key
+        if kind == "rollout_stream":
+            # the IPC channel is one framed call per batch — there is no
+            # chunk conduit to a child. The ReplicaSet routes streams to
+            # thread replicas; this is the typed backstop for direct callers.
+            exc = RuntimeError(
+                f"streamed rollouts are not supported over the "
+                f"process-worker IPC channel ({self.model}/{self.idx}); "
+                f"route to a thread-backend replica")
+            for r in reqs:
+                if r.stream is not None:
+                    r.stream.fail(exc)
+                r.future.set_exception(exc)
+            return [{"error": "stream-unsupported"}] * len(reqs)
         w = self.worker
         if w is None:
             raise WorkerLostError(
@@ -524,6 +539,11 @@ class ReplicaSet:
         self._rr = 0
         self._lock = threading.Lock()
         self._supervised = False
+        # monotonic index source for replicas added LIVE (autoscaler
+        # scale-up): indices are never renumbered or reused, so per-replica
+        # gauges and health rows keyed on idx can't alias across a
+        # grow/shrink cycle
+        self._next_idx = len(self.replicas)
         from distegnn_tpu.serve.supervisor import ReplicaSupervisor
         self.supervisor = ReplicaSupervisor(self, **(supervisor_opts or {}))
 
@@ -575,29 +595,112 @@ class ReplicaSet:
         return self._admit("predict", graph, bucket, request_id)
 
     def submit_rollout(self, scene: dict,
-                       request_id: Optional[str] = None) -> ServeFuture:
-        return self._admit("rollout", scene, None, request_id)
+                       request_id: Optional[str] = None,
+                       stream=None) -> ServeFuture:
+        return self._admit("rollout", scene, None, request_id, stream=stream)
+
+    # ---- elastic membership (autoscaler surface) -------------------------
+    def add_replica(self, build_fn, warm_sizes=None) -> Replica:
+        """Grow the set LIVE by one replica built by ``build_fn(idx) ->
+        Replica`` (the registry's per-model factory). The new replica gets a
+        fresh monotonic index, is started AND warmed at ``warm_sizes``
+        BEFORE it becomes visible (so admission never picks a half-built
+        member, and a mid-spike scale-up never routes live traffic into a
+        compile storm) — warmup failure is non-fatal, the replica just
+        compiles lazily on first traffic. Because the supervisor's tick
+        iterates the live list, the new member is supervised from its next
+        tick with no extra wiring. Raises whatever the factory or queue
+        start raises; nothing is appended on failure."""
+        with self._lock:
+            idx = self._next_idx
+            self._next_idx += 1
+        replica = build_fn(idx)
+        replica.idx = idx
+        replica.start_queue()
+        if warm_sizes:
+            try:
+                replica.warmup(warm_sizes)
+            except Exception as exc:
+                obs.log(f"replica {idx}: pre-visibility warmup failed "
+                        f"({exc!r}); compiling lazily on first traffic")
+        replica.state = "running"
+        replica.started_at = time.perf_counter()
+        with self._lock:
+            self.replicas.append(replica)
+        return replica
+
+    def retire_replica(self, drain_timeout_s: float = 30.0
+                       ) -> Optional[Replica]:
+        """Shrink the set LIVE by one replica, preserving at-most-once: the
+        victim (the newest running replica; replica 0 — the registry's
+        engine handle — is never retired) first stops being choosable
+        (state ``retiring`` fails ``healthy()``), then its in-flight set
+        and queue depth drain (bounded by ``drain_timeout_s``), then its
+        queue stops with drain and the replica leaves the list. Returns
+        the retired replica, or None when only one running replica
+        remains."""
+        with self._lock:
+            running = [r for r in self.replicas if r.state == "running"]
+            if len(running) <= 1:
+                return None
+            victim = running[-1]
+            if victim is self.replicas[0]:
+                return None
+            victim.state = "retiring"
+        deadline = time.perf_counter() + float(drain_timeout_s)
+        while time.perf_counter() < deadline:
+            if victim.inflight_count() == 0 and victim.queue.depth() == 0:
+                break
+            time.sleep(0.01)
+        # claim whatever the drain window could not flush (a wedged
+        # dispatcher) BEFORE stopping the queue — the supervisor's ordering:
+        # stop would fail the stragglers' inner futures, and the done
+        # callback passes a non-crash error straight to the client; a claim
+        # is compare-and-pop, so a result that races in still wins exactly
+        # once
+        self.fail_over_replica(victim, reason="retired with work in flight")
+        victim.queue.stop(drain=True, join_timeout_s=float(drain_timeout_s))
+        victim.state = "stopped"
+        with self._lock:
+            if victim in self.replicas:
+                self.replicas.remove(victim)
+        return victim
+
+    def supports_streaming(self) -> bool:
+        """True when some member executes in-process (a plain RequestQueue)
+        — the chunk conduit can't cross the worker IPC channel, so the
+        gateway falls back to buffered rollouts when this is False."""
+        with self._lock:
+            return any(not isinstance(r.queue, WorkerQueue)
+                       for r in self.replicas)
 
     # ---- dispatch / failover ---------------------------------------------
-    def _admit(self, kind: str, payload: dict, bucket, request_id) -> ServeFuture:
+    def _admit(self, kind: str, payload: dict, bucket, request_id,
+               stream=None) -> ServeFuture:
         now = time.perf_counter()
         outer = ServeFuture(
             hard_deadline=now + self.request_timeout + self.result_margin)
-        rec = _Tracked(kind, payload, bucket, request_id, outer)
+        rec = _Tracked(kind, payload, bucket, request_id, outer,
+                       stream=stream)
         self._dispatch(rec, admission=True)
         return outer
 
-    def _choose(self, exclude: Set[int]) -> Optional[Replica]:
+    def _choose(self, exclude: Set[int],
+                thread_only: bool = False) -> Optional[Replica]:
         with self._lock:
             cands = [r for r in self.replicas
-                     if r.idx not in exclude and r.healthy()]
+                     if r.idx not in exclude and r.healthy()
+                     and not (thread_only and isinstance(r.queue,
+                                                         WorkerQueue))]
             if not cands:
                 return None
             self._rr += 1
             return cands[self._rr % len(cands)]
 
     def _dispatch(self, rec: _Tracked, admission: bool) -> None:
-        replica = self._choose(rec.tried)
+        # streams need an in-process executor: the chunk conduit can't
+        # cross the worker IPC channel
+        replica = self._choose(rec.tried, thread_only=rec.stream is not None)
         if replica is None:
             if not self._supervised and not rec.tried:
                 # legacy pass-through: an unstarted/unsupervised set surfaces
@@ -610,12 +713,15 @@ class ReplicaSet:
                 if admission:
                     raise exc
                 rec.outer.set_exception(exc)
+                if rec.stream is not None:
+                    rec.stream.fail(exc)
                 return
         rec.tried.add(replica.idx)
         try:
             if rec.kind == "rollout":
                 inner = replica.queue.submit_rollout(
-                    rec.payload, request_id=rec.request_id)
+                    rec.payload, request_id=rec.request_id,
+                    stream=rec.stream)
             else:
                 inner = replica.queue.submit(
                     rec.payload, bucket=rec.bucket, request_id=rec.request_id)
@@ -635,7 +741,11 @@ class ReplicaSet:
         if not replica.untrack(rec):
             return  # supervisor already claimed it (drained for failover)
         exc = inner.exception()
-        if isinstance(exc, DispatcherCrashError):
+        if isinstance(exc, DispatcherCrashError) and rec.stream is None:
+            # streams are deliberately NOT failed over: the client may have
+            # already consumed a chunk prefix, and a re-dispatch would
+            # replay it from step 0 — the sink carries the typed error and
+            # the client retries the whole request instead
             self._fail_over(rec, replica, reason=str(exc))
             return
         rec.outer.meta.update(inner.meta)
@@ -646,6 +756,15 @@ class ReplicaSet:
             rec.outer.set_result(inner._result)
 
     def _fail_over(self, rec: _Tracked, dead: Replica, reason: str) -> None:
+        if rec.stream is not None:
+            # no stream failover (see _on_inner_done): surface the typed
+            # error on both the future and the sink so the consumer ends
+            exc = DispatcherCrashError(
+                f"streamed rollout lost its replica ({reason[:160]}); "
+                f"streams are not failed over — retry the request")
+            rec.outer.set_exception(exc)
+            rec.stream.fail(exc)
+            return
         self.metrics.failed_over()
         obs.event("gateway/replica_failover", model=self.model,
                   replica=dead.idx, request_id=rec.request_id,

@@ -34,6 +34,18 @@ queue via ``RequestQueue.stop(drain=True)`` so EVERY accepted request
 resolves with a real status (200/429/504), waits for in-flight handlers,
 then stops the accept loop — the process exits 0.
 
+Elasticity and streaming (the SLO-driven elasticity PR):
+``POST .../rollout?stream=1`` answers with HTTP chunked transfer — one
+NDJSON line per ``chunk_steps``-step trajectory slice, so step 1 arrives
+while step 500 is still computing, and a client disconnect cancels the
+remaining compute at the next chunk boundary. Admission is priority-aware:
+predicts are ``interactive``, rollouts are ``bulk`` (header-overridable);
+bulk is capped at ``bulk_max_inflight_frac`` of the slots and deferred
+outright while the rolling SLO window is degraded. A
+:class:`~distegnn_tpu.serve.autoscale.ReplicaAutoscaler` (opt-in via
+``serve.autoscale.enable``) grows/shrinks each model's replica fleet live
+from the same window.
+
 Every request runs inside an obs span (``serve/http`` with route/status
 attrs) and lands in per-route latency reservoirs plus shed/timeout counters
 in the metrics registry (the process-global obs registry by default), so
@@ -44,20 +56,24 @@ from __future__ import annotations
 
 import base64
 import json
+import queue as _pyqueue
 import signal
 import threading
 import time
 import uuid
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional, Tuple
+from urllib.parse import parse_qs, urlsplit
 
 import numpy as np
 
 from distegnn_tpu import obs
 from distegnn_tpu.obs.metrics import MetricsRegistry, _prom_name
+from distegnn_tpu.serve.autoscale import ReplicaAutoscaler
 from distegnn_tpu.serve.buckets import BucketOverflowError
 from distegnn_tpu.serve.engine import RolloutOverflowError
-from distegnn_tpu.serve.queue import QueueFullError, RequestTimeoutError
+from distegnn_tpu.serve.queue import (QueueFullError, RequestTimeoutError,
+                                      StreamSink)
 from distegnn_tpu.serve.registry import (ModelRegistry, SwapError,
                                          SwapInProgressError)
 from distegnn_tpu.serve.replica import ModelUnavailableError
@@ -237,11 +253,26 @@ def scene_from_payload(payload: dict) -> dict:
 
 _GATEWAY_COUNTERS = (
     "requests_total", "predict_ok", "rollout_ok", "shed_inflight",
-    "shed_queue_full", "timeouts", "bad_requests", "unknown_model",
-    "overflow_rejected", "draining_rejected", "rollout_overflow",
-    "model_unavailable", "swap_ok", "swap_failed",
+    "shed_bulk", "shed_queue_full", "timeouts", "bad_requests",
+    "unknown_model", "overflow_rejected", "draining_rejected",
+    "rollout_overflow", "model_unavailable", "swap_ok", "swap_failed",
+    "stream_ok", "stream_cancelled",
     "errors",
 )
+
+# priority classes: interactive (predicts — a human is waiting) outranks
+# bulk (rollouts — batch trajectory generation). Clients override with the
+# priority header (serve.priority.header, default X-Priority).
+_PRIORITY_CLASSES = ("interactive", "bulk")
+
+_PRIORITY_DEFAULTS = {
+    "enable": True,
+    "header": "X-Priority",
+    "bulk_max_inflight_frac": 0.75,
+    "degrade_shed_rate": 0.05,
+    "degrade_p99_ms": None,
+    "bulk_retry_factor": 4.0,
+}
 
 
 class _Server(ThreadingHTTPServer):
@@ -267,7 +298,10 @@ class Gateway:
                  port: int = 0, max_inflight: int = 64,
                  drain_grace_s: float = 10.0,
                  metrics_registry: Optional[MetricsRegistry] = None,
-                 slo_window_s: float = 60.0):
+                 slo_window_s: float = 60.0,
+                 autoscale: Optional[dict] = None,
+                 priority: Optional[dict] = None,
+                 stream_chunk_steps: int = 8):
         from distegnn_tpu.obs.slo import SLOMonitor
 
         self.registry = registry
@@ -282,10 +316,34 @@ class Gateway:
         self._inflight_gauge = self._reg.gauge("gateway/inflight")
         self._ready_gauge = self._reg.gauge("gateway/ready")
         self._inflight = 0
+        self._inflight_bulk = 0
         self._inflight_lock = threading.Lock()
         self._accepting = True
         self._draining = False
         self._drain_lock = threading.Lock()
+        # priority admission: bulk (rollouts) is capped at a fraction of
+        # max_inflight so interactive predicts always find headroom, and is
+        # deferred outright while the SLO window is degraded
+        pk = dict(_PRIORITY_DEFAULTS)
+        pk.update(dict(priority or {}))
+        self.priority_enable = bool(pk["enable"])
+        self.priority_header = str(pk["header"])
+        frac = float(pk["bulk_max_inflight_frac"])
+        self.bulk_max_inflight = max(1, int(self.max_inflight * frac))
+        self.degrade_shed_rate = float(pk["degrade_shed_rate"])
+        self.degrade_p99_ms = (None if pk["degrade_p99_ms"] is None
+                               else float(pk["degrade_p99_ms"]))
+        self.bulk_retry_factor = float(pk["bulk_retry_factor"])
+        self._degraded_cache = (0.0, False)   # (checked_at, degraded)
+        self._degraded_lock = threading.Lock()
+        # streaming rollouts: server-side chunk size (per-request
+        # "chunk_steps" in the body overrides)
+        self.stream_chunk_steps = max(1, int(stream_chunk_steps))
+        # the elasticity control loop (no-op thread unless autoscale.enable)
+        self.autoscaler = ReplicaAutoscaler(
+            registry, self.slo_monitor, config=autoscale,
+            metrics_registry=self._reg)
+        self.autoscaler.start()
         self.httpd = _Server((host, int(port)), _make_handler(self))
 
     # ---- addresses -------------------------------------------------------
@@ -328,6 +386,8 @@ class Gateway:
             self._draining = True
         self._accepting = False
         self._ready_gauge.set(0.0)
+        # the autoscaler must not grow/shrink a fleet that is draining
+        self.autoscaler.stop()
         obs.event("gateway/drain_begin", inflight=self._inflight)
         # every admitted future resolves; models drain CONCURRENTLY, each
         # bounded by the grace budget (registry.stop). Signature-aware so a
@@ -351,6 +411,7 @@ class Gateway:
         self.httpd.shutdown()
 
     def close(self) -> None:
+        self.autoscaler.stop()
         self.httpd.server_close()
 
     def ready(self) -> bool:
@@ -386,7 +447,7 @@ class Gateway:
                 self._c["bad_requests"].add(1)
                 status = self._send_json(handler, 400, {
                     "error": str(exc), "type": "PayloadError"})
-            except BrokenPipeError:
+            except ConnectionError:
                 status = 499        # client went away mid-response
             except Exception as exc:
                 self._c["errors"].add(1)
@@ -428,15 +489,20 @@ class Gateway:
                     "ready": False, "reason": "draining"},
                     retry_after=self.drain_grace_s)
             health = self.registry.health()
+            scale = (self.autoscaler.status()
+                     if self.autoscaler.enable else None)
             if fully_ready:
-                return self._send_json(h, 200, {"ready": True,
-                                                "models": health})
+                body = {"ready": True, "models": health}
+                if scale is not None:
+                    body["autoscale"] = scale
+                return self._send_json(h, 200, body)
             if self.registry.any_ready():
                 # degraded: the broken model 503s on its own routes while
                 # every ready model keeps serving — report which is which
-                return self._send_json(h, 200, {"ready": True,
-                                                "degraded": True,
-                                                "models": health})
+                body = {"ready": True, "degraded": True, "models": health}
+                if scale is not None:
+                    body["autoscale"] = scale
+                return self._send_json(h, 200, body)
             return self._send_json(h, 503, {
                 "ready": False,
                 "reason": "models not warmed or dispatcher down",
@@ -449,9 +515,62 @@ class Gateway:
         return self._send_json(h, 404, {"error": f"no route {path}",
                                         "type": "NotFound"})
 
+    def _priority_of(self, h, route: str) -> str:
+        """Admission class for one inference request: the priority header
+        when present and sane, else predicts are interactive (a caller is
+        blocked on the answer) and rollouts are bulk (batch trajectory
+        generation that can wait). Always interactive when priority
+        admission is disabled."""
+        if not self.priority_enable:
+            return "interactive"
+        supplied = h.headers.get(self.priority_header)
+        if supplied:
+            val = str(supplied).strip().lower()
+            if val in _PRIORITY_CLASSES:
+                return val
+        return "bulk" if route == "rollout" else "interactive"
+
+    def _window_degraded(self) -> bool:
+        """True while the rolling SLO window says the gateway is hurting
+        (shed rate or predict p99 past the priority thresholds). Cached for
+        250ms — admission is on the hot path, the window math is not."""
+        now = time.monotonic()
+        with self._degraded_lock:
+            checked_at, val = self._degraded_cache
+            if now - checked_at < 0.25:
+                return val
+        snap = self.slo_monitor.window_snapshot()
+        deg = snap.get("shed_rate", 0.0) > self.degrade_shed_rate
+        if not deg and self.degrade_p99_ms is not None:
+            p99 = snap.get("predict_p99_ms")
+            deg = p99 is not None and p99 > self.degrade_p99_ms
+        with self._degraded_lock:
+            self._degraded_cache = (now, deg)
+        return deg
+
     def _infer(self, h, path: str, route: str) -> int:
         name = path[len("/v1/models/"):-(len(route) + 1)]
-        if not self._try_acquire():
+        pri = self._priority_of(h, route)
+        if pri == "bulk" and self._window_degraded():
+            # the window says interactive traffic is hurting: defer bulk
+            # outright so every freed slot goes to interactive work
+            self._c["shed_bulk"].add(1)
+            return self._send_json(h, 429, {
+                "error": "SLO window degraded; bulk work deferred — retry "
+                         "with backoff", "type": "BulkDeferred",
+                "priority": "bulk"},
+                retry_after=1.0 * self.bulk_retry_factor)
+        if not self._try_acquire(pri):
+            if pri == "bulk":
+                # interactive still has headroom; only the bulk share is
+                # spoken for — back bulk clients off harder
+                self._c["shed_bulk"].add(1)
+                return self._send_json(h, 429, {
+                    "error": f"bulk admission at "
+                             f"bulk_max_inflight={self.bulk_max_inflight}; "
+                             "retry with backoff", "type": "Overloaded",
+                    "priority": "bulk"},
+                    retry_after=0.5 * self.bulk_retry_factor)
             self._c["shed_inflight"].add(1)
             return self._send_json(h, 429, {
                 "error": f"gateway at max_inflight={self.max_inflight}; "
@@ -481,7 +600,7 @@ class Gateway:
                 return self._rollout_admitted(h, name, entry)
             return self._predict_admitted(h, name, entry)
         finally:
-            self._release()
+            self._release(pri)
 
     def _submit_guarded(self, h, submit_fn, entry=None):
         """Run one queue submit, mapping the admission errors to their HTTP
@@ -591,6 +710,9 @@ class Gateway:
             raise PayloadError("'encoding' must be 'list' or 'b64'")
         t0 = time.perf_counter()
         rid = getattr(h, "request_id", None)
+        if self._wants_stream(h):
+            return self._rollout_streamed(h, name, entry, payload, scene,
+                                          encoding, rid, t0)
         fut, status = self._submit_guarded(
             h, lambda: entry.queue.submit_rollout(scene, request_id=rid),
             entry)
@@ -628,6 +750,194 @@ class Gateway:
             "batch_filled": meta.get("batch_filled"),
             "total_ms": round((time.perf_counter() - t0) * 1e3, 3),
         })
+
+    # ---- chunked streaming rollouts --------------------------------------
+    @staticmethod
+    def _wants_stream(h) -> bool:
+        """``?stream=1`` on the rollout URL (dispatch strips the query
+        before routing; the raw handler path still carries it)."""
+        vals = parse_qs(urlsplit(h.path).query).get("stream")
+        return bool(vals) and vals[-1].lower() in ("1", "true", "yes", "on")
+
+    def _stream_chunk(self, payload: dict) -> int:
+        chunk = payload.get("chunk_steps")
+        if chunk is None:
+            return self.stream_chunk_steps
+        try:
+            chunk = int(chunk)
+        except (TypeError, ValueError):
+            raise PayloadError("'chunk_steps' must be an integer >= 1") \
+                from None
+        if chunk < 1:
+            raise PayloadError(f"'chunk_steps' must be >= 1 (got {chunk})")
+        return chunk
+
+    def _rollout_streamed(self, h, name: str, entry, payload: dict,
+                          scene: dict, encoding: str, rid, t0) -> int:
+        """``POST .../rollout?stream=1``: HTTP chunked transfer, one NDJSON
+        line per trajectory chunk so step 1 arrives while step 500 is still
+        computing, then a summary line. A client disconnect (detected at the
+        next chunk write) cancels the remaining compute at the next chunk
+        boundary and frees the admission slot."""
+        chunk = self._stream_chunk(payload)
+        scene = dict(scene)
+        scene["chunk_steps"] = chunk
+        supports = getattr(entry.queue, "supports_streaming", None)
+        if callable(supports) and not supports():
+            # process-worker replicas can't push chunks over the IPC
+            # channel: serve one buffered rollout re-chunked at the edge —
+            # same wire contract, just without the early first chunk
+            return self._rollout_stream_fallback(h, name, entry, scene,
+                                                 encoding, rid, t0, chunk)
+        sink = StreamSink()
+        fut, status = self._submit_guarded(
+            h, lambda: entry.queue.submit_rollout(scene, request_id=rid,
+                                                  stream=sink), entry)
+        if fut is None:
+            return status
+        # admitted: from here the response is chunked NDJSON. Bound the
+        # consumer loop by the queue's own hard deadline so a wedged
+        # replica can't hold the socket forever.
+        deadline = time.monotonic() \
+            + float(getattr(entry.queue, "request_timeout", 30.0)) \
+            + float(getattr(entry.queue, "result_margin", 5.0))
+        self._begin_chunked(h, rid)
+        steps_done = 0
+        err_line = None
+        try:
+            while True:
+                try:
+                    kind, a, b = sink.next(timeout=0.25)
+                except _pyqueue.Empty:
+                    if time.monotonic() > deadline:
+                        sink.cancel()
+                        self._c["timeouts"].add(1)
+                        err_line = {"error": "stream timed out",
+                                    "type": "RequestTimeout"}
+                        break
+                    continue
+                if kind == "chunk":
+                    start, traj = int(a), b
+                    self._write_chunk(h, json.dumps({
+                        "start_step": start,
+                        "steps": int(traj.shape[0]),
+                        "chunk": encode_array(traj, encoding)}) + "\n")
+                    steps_done = start + int(traj.shape[0])
+                elif kind == "done":
+                    summary = a or {}
+                    self._c["rollout_ok"].add(1)
+                    self._c["stream_ok"].add(1)
+                    self._write_chunk(h, json.dumps({
+                        "done": True, "request_id": rid, "model": name,
+                        "n": int(scene["loc"].shape[0]),
+                        "steps": int(summary.get("steps_done", steps_done)),
+                        "steps_total": int(summary.get("steps_total",
+                                                       scene["steps"])),
+                        "cancelled": bool(summary.get("cancelled", False)),
+                        "total_ms": round((time.perf_counter() - t0) * 1e3,
+                                          3)}) + "\n")
+                    break
+                else:           # ("error", exc, None)
+                    self._count_stream_error(a)
+                    err_line = {"error": str(a), "type": type(a).__name__}
+                    break
+            if err_line is not None:
+                err_line["request_id"] = rid
+                self._write_chunk(h, json.dumps(err_line) + "\n")
+            self._end_chunked(h)
+        except ConnectionError:
+            # the client went away mid-stream (EPIPE or RST, depending on
+            # timing): flag the sink so the engine stops at the next chunk
+            # boundary (it emits serve/stream_cancelled with the
+            # skipped-step count), free the slot, and let dispatch record
+            # the 499
+            sink.cancel()
+            self._c["stream_cancelled"].add(1)
+            raise
+        return 200
+
+    def _rollout_stream_fallback(self, h, name: str, entry, scene: dict,
+                                 encoding: str, rid, t0, chunk: int) -> int:
+        """Streaming contract over a non-streaming backend: run the buffered
+        rollout, then replay it as NDJSON chunks. Bitwise-identical chunk
+        lines, no early first chunk (the backend can't provide one)."""
+        fut, status = self._submit_guarded(
+            h, lambda: entry.queue.submit_rollout(scene, request_id=rid),
+            entry)
+        if fut is None:
+            return status
+        try:
+            traj = fut.result()
+        except RequestTimeoutError as exc:
+            self._c["timeouts"].add(1)
+            return self._send_json(h, 504, {"error": str(exc),
+                                            "type": "RequestTimeout"})
+        except ModelUnavailableError as exc:
+            self._c["model_unavailable"].add(1)
+            return self._send_json(
+                h, 503, {"error": str(exc), "type": "ModelUnavailable",
+                         "model": exc.model},
+                retry_after=exc.retry_after_s)
+        except RolloutOverflowError as exc:
+            self._c["rollout_overflow"].add(1)
+            return self._send_json(h, 422, {"error": str(exc),
+                                            "type": "RolloutOverflow"})
+        steps = int(traj.shape[0])
+        self._begin_chunked(h, rid)
+        try:
+            done = 0
+            while done < steps:
+                c = min(chunk, steps - done)
+                self._write_chunk(h, json.dumps({
+                    "start_step": done, "steps": c,
+                    "chunk": encode_array(traj[done:done + c],
+                                          encoding)}) + "\n")
+                done += c
+            self._c["rollout_ok"].add(1)
+            self._c["stream_ok"].add(1)
+            self._write_chunk(h, json.dumps({
+                "done": True, "request_id": rid, "model": name,
+                "n": int(scene["loc"].shape[0]), "steps": steps,
+                "steps_total": steps, "cancelled": False,
+                "total_ms": round((time.perf_counter() - t0) * 1e3,
+                                  3)}) + "\n")
+            self._end_chunked(h)
+        except ConnectionError:
+            self._c["stream_cancelled"].add(1)
+            raise
+        return 200
+
+    def _count_stream_error(self, exc) -> None:
+        if isinstance(exc, RequestTimeoutError):
+            self._c["timeouts"].add(1)
+        elif isinstance(exc, RolloutOverflowError):
+            self._c["rollout_overflow"].add(1)
+        elif isinstance(exc, ModelUnavailableError):
+            self._c["model_unavailable"].add(1)
+        else:
+            self._c["errors"].add(1)
+
+    @staticmethod
+    def _begin_chunked(h, rid) -> None:
+        h.send_response(200)
+        h.send_header("Content-Type", "application/x-ndjson")
+        h.send_header("Transfer-Encoding", "chunked")
+        if rid is not None:
+            h.send_header("X-Request-Id", rid)
+        h.end_headers()
+
+    @staticmethod
+    def _write_chunk(h, text: str) -> None:
+        data = text.encode("utf-8")
+        h.wfile.write(f"{len(data):x}\r\n".encode("ascii"))
+        h.wfile.write(data)
+        h.wfile.write(b"\r\n")
+        h.wfile.flush()
+
+    @staticmethod
+    def _end_chunked(h) -> None:
+        h.wfile.write(b"0\r\n\r\n")
+        h.wfile.flush()
 
     # ---- blue/green hot-swap --------------------------------------------
     def _swap(self, h, path: str) -> int:
@@ -699,16 +1009,24 @@ class Gateway:
         return "".join(parts)
 
     # ---- plumbing --------------------------------------------------------
-    def _try_acquire(self) -> bool:
+    def _try_acquire(self, priority: str = "interactive") -> bool:
+        bulk = self.priority_enable and priority == "bulk"
         with self._inflight_lock:
             if self._inflight >= self.max_inflight:
                 return False
+            if bulk and self._inflight_bulk >= self.bulk_max_inflight:
+                return False
             self._inflight += 1
+            if bulk:
+                self._inflight_bulk += 1
             return True
 
-    def _release(self) -> None:
+    def _release(self, priority: str = "interactive") -> None:
+        bulk = self.priority_enable and priority == "bulk"
         with self._inflight_lock:
             self._inflight -= 1
+            if bulk:
+                self._inflight_bulk -= 1
 
     @staticmethod
     def _read_json(h) -> dict:

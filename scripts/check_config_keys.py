@@ -1,0 +1,269 @@
+#!/usr/bin/env python
+"""Lint: every serving-control config key ships a typed default AND a
+validation branch — the two halves of the schema cannot drift apart.
+
+The config contract (docs/SERVING.md "Config") routes every serve-layer
+knob through ``config._DEFAULTS`` (so hand-built ``ConfigDict(_DEFAULTS)``
+configs always carry it) and through ``validate_config`` (so a typo'd or
+out-of-range value fails at load time, not as a silent attribute miss deep
+in the gateway). A key present in one side but not the other is exactly
+the hole this lint exists to catch: a default nobody validates, or a
+validator guarding a knob nobody can set.
+
+Checked, by AST walk over distegnn_tpu/config.py, for each section in
+``SECTIONS`` (the serve sub-mappings that own a known-key guard):
+  1. the section exists in ``_DEFAULTS["serve"]`` and in
+     ``validate_config`` (bound via ``<var> = s.get("<section>")``);
+  2. the section's validator rejects unknown keys
+     (``for key in <var>: if key not in <tuple>``);
+  3. every default key is named by the validator (in the known-keys tuple
+     or a ``<var>.get("key")`` / ``<var>["key"]`` access) — and every key
+     the validator names has a default.
+Plus one cross-module check: ``serve/autoscale.py``'s in-code ``_DEFAULTS``
+fallback carries exactly the same knob set as the config section (its
+docstring promises this file keeps them in lockstep).
+
+Wired into tier-1 via tests/test_elasticity.py::test_config_key_lint_clean.
+Exit codes: 0 clean, 1 violations (one ``path:line: text`` per finding).
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+CONFIG = os.path.join(REPO, "distegnn_tpu", "config.py")
+AUTOSCALE = os.path.join(REPO, "distegnn_tpu", "serve", "autoscale.py")
+
+# serve.<section> mappings whose validators own an unknown-key guard
+SECTIONS = ("worker", "supervisor", "autoscale", "priority", "stream")
+
+
+def _const_str(node: ast.AST):
+    return node.value if (isinstance(node, ast.Constant)
+                          and isinstance(node.value, str)) else None
+
+
+def _str_tuple(node: ast.AST):
+    """frozenset of element strings for a tuple/list of string constants,
+    else None."""
+    if not isinstance(node, (ast.Tuple, ast.List)):
+        return None
+    vals = [_const_str(e) for e in node.elts]
+    if vals and all(v is not None for v in vals):
+        return frozenset(vals)
+    return None
+
+
+def _dict_get(node: ast.Dict, key: str):
+    for k, v in zip(node.keys, node.values):
+        if _const_str(k) == key:
+            return v
+    return None
+
+
+def _defaults_sections(tree: ast.Module, rel: str):
+    """{section: ({key: lineno}, section_lineno)} from _DEFAULTS['serve'],
+    plus violations for missing structure."""
+    out, violations = {}, []
+    serve = None
+    for node in tree.body:
+        targets = (node.targets if isinstance(node, ast.Assign)
+                   else [node.target] if isinstance(node, ast.AnnAssign)
+                   else [])
+        if any(isinstance(t, ast.Name) and t.id == "_DEFAULTS"
+               for t in targets):
+            if isinstance(node.value, ast.Dict):
+                serve = _dict_get(node.value, "serve")
+            break
+    if not isinstance(serve, ast.Dict):
+        violations.append((rel, 1, "_DEFAULTS has no literal 'serve' "
+                                   "mapping — config layout changed under "
+                                   "the lint; update check_config_keys.py"))
+        return out, violations
+    for section in SECTIONS:
+        sec = _dict_get(serve, section)
+        if not isinstance(sec, ast.Dict):
+            violations.append((rel, serve.lineno,
+                               f"_DEFAULTS serve.{section} is missing or "
+                               f"not a literal mapping"))
+            continue
+        keys = {}
+        for k in sec.keys:
+            name = _const_str(k)
+            if name is not None:
+                keys[name] = k.lineno
+        out[section] = (keys, sec.lineno)
+    return out, violations
+
+
+def _find_validate(tree: ast.Module):
+    for node in tree.body:
+        if isinstance(node, ast.FunctionDef) and \
+                node.name == "validate_config":
+            return node
+    return None
+
+
+def _validated_sections(fn: ast.FunctionDef):
+    """{section: (validated key set, has unknown-key guard, lineno)} by
+    tracking ``<var> = s.get("<section>")`` bindings through the function."""
+    # string-tuple environment: aknown = ("enable", ...), known = (...), ...
+    env = {}
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Assign) and len(node.targets) == 1 and \
+                isinstance(node.targets[0], ast.Name):
+            vals = _str_tuple(node.value)
+            if vals is not None:
+                env[node.targets[0].id] = vals
+
+    # section variable bindings: a = s.get("autoscale"), w = s.get("worker")
+    var_of = {}
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Assign) and len(node.targets) == 1 and \
+                isinstance(node.targets[0], ast.Name) and \
+                isinstance(node.value, ast.Call):
+            call = node.value
+            if isinstance(call.func, ast.Attribute) and \
+                    call.func.attr == "get" and call.args:
+                section = _const_str(call.args[0])
+                if section in SECTIONS:
+                    var_of[section] = (node.targets[0].id, node.lineno)
+
+    def _refs(tree: ast.AST, var: str) -> bool:
+        return any(isinstance(n, ast.Name) and n.id == var
+                   for n in ast.walk(tree))
+
+    out = {}
+    for section, (var, lineno) in var_of.items():
+        validated, guarded = set(), False
+        for node in ast.walk(fn):
+            # <var>.get("key", ...) / <var>["key"]
+            if isinstance(node, ast.Call) and \
+                    isinstance(node.func, ast.Attribute) and \
+                    node.func.attr == "get" and \
+                    isinstance(node.func.value, ast.Name) and \
+                    node.func.value.id == var and node.args:
+                key = _const_str(node.args[0])
+                if key is not None:
+                    validated.add(key)
+            if isinstance(node, ast.Subscript) and \
+                    isinstance(node.value, ast.Name) and \
+                    node.value.id == var:
+                key = _const_str(node.slice)
+                if key is not None:
+                    validated.add(key)
+            if not isinstance(node, ast.For):
+                continue
+            # for key in <var>: ... key not in <tuple> -> unknown-key guard
+            if isinstance(node.iter, ast.Name) and node.iter.id == var:
+                for cmp_ in ast.walk(node):
+                    if isinstance(cmp_, ast.Compare) and any(
+                            isinstance(op, ast.NotIn) for op in cmp_.ops):
+                        comp = cmp_.comparators[0]
+                        vals = _str_tuple(comp)
+                        if vals is None and isinstance(comp, ast.Name):
+                            vals = env.get(comp.id)
+                        if vals is not None:
+                            guarded = True
+                            validated |= vals
+            # for key in <known tuple>: ... <var>[key] range checks
+            else:
+                vals = _str_tuple(node.iter)
+                if vals is None and isinstance(node.iter, ast.Name):
+                    vals = env.get(node.iter.id)
+                if vals is not None and _refs(node, var):
+                    validated |= vals
+        out[section] = (validated, guarded, lineno)
+    return out
+
+
+def _autoscale_module_keys(path: str):
+    """Knob names of serve/autoscale.py's module-level _DEFAULTS dict."""
+    with open(path, encoding="utf-8") as f:
+        tree = ast.parse(f.read(), filename=path)
+    for node in tree.body:
+        targets = (node.targets if isinstance(node, ast.Assign)
+                   else [node.target] if isinstance(node, ast.AnnAssign)
+                   else [])
+        if any(isinstance(t, ast.Name) and t.id == "_DEFAULTS"
+               for t in targets):
+            value = node.value
+            if isinstance(value, ast.Dict):
+                keys = {_const_str(k) for k in value.keys}
+                keys.discard(None)
+                return keys, node.lineno
+    return None, 1
+
+
+def find_violations(config_path: str = CONFIG,
+                    autoscale_path: str = AUTOSCALE):
+    """[(relpath, lineno, message)] against the schema-lockstep contract."""
+    rel = os.path.relpath(config_path, REPO).replace(os.sep, "/")
+    with open(config_path, encoding="utf-8") as f:
+        tree = ast.parse(f.read(), filename=config_path)
+
+    defaults, out = _defaults_sections(tree, rel)
+
+    validate = _find_validate(tree)
+    if validate is None:
+        out.append((rel, 1, "no validate_config function found"))
+        return out
+    validated = _validated_sections(validate)
+
+    for section in SECTIONS:
+        if section not in defaults:
+            continue  # already reported by _defaults_sections
+        keys, sec_line = defaults[section]
+        if section not in validated:
+            out.append((rel, validate.lineno,
+                        f"validate_config never reads serve.{section} "
+                        f"(expected <var> = s.get({section!r}))"))
+            continue
+        seen, guarded, v_line = validated[section]
+        if not guarded:
+            out.append((rel, v_line,
+                        f"serve.{section} validator has no unknown-key "
+                        f"rejection loop (for key in <var>: ... not in ...)"))
+        for key in sorted(set(keys) - seen):
+            out.append((rel, keys[key],
+                        f"serve.{section}.{key} has a default but no "
+                        f"validation branch in validate_config"))
+        for key in sorted(seen - set(keys)):
+            out.append((rel, v_line,
+                        f"validate_config names serve.{section}.{key} but "
+                        f"_DEFAULTS ships no typed default for it"))
+
+    if autoscale_path and "autoscale" in defaults:
+        arel = os.path.relpath(autoscale_path, REPO).replace(os.sep, "/")
+        mod_keys, a_line = _autoscale_module_keys(autoscale_path)
+        cfg_keys = set(defaults["autoscale"][0])
+        if mod_keys is None:
+            out.append((arel, a_line,
+                        "no module-level _DEFAULTS dict found — the "
+                        "autoscaler's in-code fallback knob set is gone"))
+        elif mod_keys != cfg_keys:
+            out.append((arel, a_line,
+                        f"autoscale._DEFAULTS drifted from config "
+                        f"serve.autoscale: only-in-module="
+                        f"{sorted(mod_keys - cfg_keys)} only-in-config="
+                        f"{sorted(cfg_keys - mod_keys)}"))
+    return out
+
+
+def main(argv=None) -> int:
+    violations = find_violations()
+    for rel, lineno, msg in violations:
+        print(f"{rel}:{lineno}: {msg}")
+    if violations:
+        print(f"\n{len(violations)} config-key violation(s); see "
+              "scripts/check_config_keys.py docstring for the contract")
+        return 1
+    print("check_config_keys: clean")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

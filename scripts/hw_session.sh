@@ -523,6 +523,51 @@ EOF
 export -f chaos_workers_and_check
 run_bounded chaos_workers chaos_workers_and_check
 
+# 3a'''. elasticity spike drill: a spike10x replay (docs/SERVING.md
+#        "Elasticity & streaming") with execute-latency chaos against a
+#        1-replica fleet with the autoscaler on. The done-marker keys on
+#        the full 1->N->1 cycle in ONE event stream (scale_up strictly
+#        before scale_down), per-phase interactive SLO verdicts all
+#        passing, and zero lost/errored requests — elasticity reacted to
+#        the spike without sacrificing work. Bounded like 3a (fixed plan +
+#        per-request timeout + --scale-settle-s cap on the shrink wait).
+elasticity_spike_and_check() {
+  local stamp obsdir
+  stamp=$(date -u +%Y%m%dT%H%M%S)
+  obsdir=logs/traffic_gen/hw_spike_$stamp
+  python scripts/traffic_gen.py --config_path configs/nbody_serve_spike.yaml \
+    --requests 64 --rate 20 --mix "predict=0.8,session=0.2" \
+    --sizes 24,48 --sessions 4 --seed 61 --timeout-s 300 \
+    --profile spike10x \
+    --autoscale "max_replicas=3,queue_high=0.5,scale_up_cooldown_s=0.5,interval_s=0.1,scale_down_cooldown_s=1.0,idle_rounds=3,queue_low=2" \
+    --scale-settle-s 30 --chaos "latency@0.0:s=0.12" \
+    --slo configs/slo_default.yaml --obs-dir "$obsdir" \
+    | tee /tmp/spike_last.json || return 1
+  OBSDIR="$obsdir" python - <<'EOF' || return 1
+import json, os
+line = [l for l in open('/tmp/spike_last.json') if l.strip().startswith('{')][-1]
+rec = json.loads(line)
+phases = rec.get('phases') or {}
+events = [json.loads(l) for l in
+          open(os.path.join(os.environ['OBSDIR'], 'obs', 'events.jsonl'))]
+ups = [e['ts'] for e in events if e.get('name') == 'gateway/scale_up']
+downs = [e['ts'] for e in events if e.get('name') == 'gateway/scale_down']
+ok = (rec.get('value', 0) > 0
+      and rec.get('completed', 0) == rec.get('requests', -1)
+      and rec.get('lost', 1) == 0
+      and set(phases) == {'pre', 'spike', 'post'}
+      and all(p.get('slo_pass') for p in phases.values())
+      and ups and downs and min(ups) < max(downs))
+raise SystemExit(0 if ok else 1)
+EOF
+  mkdir -p docs/artifacts
+  cp /tmp/spike_last.json "docs/artifacts/elasticity_spike_$stamp.json"
+  python scripts/obs_report.py "$obsdir/obs/events.jsonl" \
+    --slo configs/slo_default.yaml
+}
+export -f elasticity_spike_and_check
+run_bounded elasticity_spike elasticity_spike_and_check
+
 # 3b. machine roofline probe (minutes): copy/matmul/gather/scatter ceilings
 #     + analytic step floor — pairs with the new hbm_gbps field in the bench
 #     line (VERDICT r4 #7) to place every lowering on the memory roofline.

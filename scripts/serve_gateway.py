@@ -77,6 +77,7 @@ def main(argv=None) -> int:
     jaxprobe.mark_warmup_done()
     jaxprobe.set_phase("serve/http")
 
+    s = cfg.serve
     gateway = Gateway(
         registry,
         host=args.host if args.host is not None else str(g.host),
@@ -85,7 +86,10 @@ def main(argv=None) -> int:
                       else int(g.max_inflight)),
         drain_grace_s=float(g.drain_grace_s),
         slo_window_s=float((cfg.get("slo") or {}).get("window_s", 60.0)
-                           or 60.0))
+                           or 60.0),
+        autoscale=dict(s.autoscale),
+        priority=dict(s.priority),
+        stream_chunk_steps=int(s.stream.chunk_steps))
     gateway.install_signal_handlers()
     host, port = gateway.address
     obs.log(f"gateway: listening on http://{host}:{port} "

@@ -12,6 +12,20 @@ gated by an on/off modulator (exponential ON phases of mean
 loop is OPEN: arrival k fires at its scheduled time regardless of
 completions, so queueing delay and shedding are measured honestly.
 
+``--profile steady|ramp|spike10x`` replaces the burst modulator with a
+phased schedule (steady: flat Poisson; ramp: 0.5x -> 1x -> 2x thirds;
+spike10x: 1x -> 10x -> 1x with half the requests inside the spike), tags
+every request with its phase, and adds per-phase p50/p99 — overall AND
+interactive-only (predict+session; rollouts are the bulk class) — plus a
+per-phase SLO verdict to the BENCH record: the elasticity drill's proof
+that interactive latency held through the spike, phase by phase.
+``--autoscale 'max_replicas=3,queue_high=2'`` turns the in-process
+gateway's replica autoscaler on (keys from serve.autoscale:; bare
+``--autoscale on`` enables it with config defaults) and
+``--scale-settle-s`` holds the gateway open after the replay until the
+fleet shrinks back to min_replicas, so one run's event stream shows the
+full 1 -> N -> 1 cycle.
+
 Traffic classes:
   predict   fresh synthetic graph per request -> POST .../predict
   session   requests drawn from a pool of --sessions sticky ids, each
@@ -146,6 +160,39 @@ def parse_chaos(spec: str):
     return sorted(events, key=lambda e: e["at"])
 
 
+def parse_scale(spec: str) -> dict:
+    """--autoscale value -> serve.autoscale overrides. 'on'/'true'/'1' is
+    bare enablement; otherwise 'key=val,...' with keys from the autoscaler's
+    knob set, coerced against the knob's default type. Passing the flag at
+    all implies enable=true unless the spec says enable=false."""
+    from distegnn_tpu.serve.autoscale import _DEFAULTS as knob_defaults
+
+    spec = spec.strip()
+    out: dict = {}
+    if spec.lower() in ("on", "true", "1", "yes"):
+        out["enable"] = True
+        return out
+    for part in spec.split(","):
+        part = part.strip()
+        if not part:
+            continue
+        key, eq, val = part.partition("=")
+        key, val = key.strip(), val.strip()
+        if not eq or key not in knob_defaults:
+            raise ValueError(
+                f"bad autoscale override {part!r} (want key=value with keys "
+                f"{', '.join(sorted(knob_defaults))})")
+        ref = knob_defaults[key]
+        if isinstance(ref, bool):
+            out[key] = val.lower() in ("1", "true", "yes", "on")
+        elif isinstance(ref, int):
+            out[key] = int(val)
+        else:                         # float knobs, incl. None-able p99 gate
+            out[key] = float(val)
+    out.setdefault("enable", True)
+    return out
+
+
 def size_sampler(sizes, alpha: float, rng: random.Random):
     """Heavy-tailed draw over ascending ladder sizes: rung k gets weight
     1/(k+1)^alpha — most traffic at the floor, a power-law tail of big
@@ -172,6 +219,33 @@ def arrival_times(n: int, rate: float, on_s: float, off_s: float,
         t += dt
         out.append(t)
     return out
+
+
+# name -> ordered (phase, request_fraction, rate_multiplier); arrivals inside
+# a phase are pure Poisson at rate * multiplier, phases laid back-to-back
+PROFILES = {
+    "steady": (("steady", 1.0, 1.0),),
+    "ramp": (("low", 1 / 3, 0.5), ("mid", 1 / 3, 1.0), ("high", 1 / 3, 2.0)),
+    "spike10x": (("pre", 0.25, 1.0), ("spike", 0.5, 10.0),
+                 ("post", 0.25, 1.0)),
+}
+
+
+def profile_arrivals(profile: str, n: int, rate: float, rng: random.Random):
+    """(arrival offsets, per-request phase tags) for a named load profile.
+    Each phase gets its request share as a pure Poisson stream at
+    rate*multiplier — the spike really is 10x denser wall-clock traffic,
+    not the same arrivals relabeled."""
+    segs = PROFILES[profile]
+    counts = [int(n * frac) for _, frac, _ in segs]
+    counts[-1] += n - sum(counts)            # rounding drift -> last phase
+    offsets, phases, t = [], [], 0.0
+    for (name, _, mult), count in zip(segs, counts):
+        for _ in range(count):
+            t += rng.expovariate(rate * mult)
+            offsets.append(t)
+            phases.append(name)
+    return offsets, phases
 
 
 def _b64_field(a, dtype):
@@ -254,8 +328,14 @@ def build_plan(args, models, rollout_models, feat_nf, edge_attr_nf):
             path = f"/v1/models/{model}/predict"
         plan.append({"cls": cls, "model": model, "path": path, "body": body,
                      "rid": rid})
-    offsets = arrival_times(args.requests, args.rate, args.burst_on_s,
-                            args.burst_off_s, rng)
+    if getattr(args, "profile", None):
+        offsets, phases = profile_arrivals(args.profile, args.requests,
+                                           args.rate, rng)
+        for item, phase in zip(plan, phases):
+            item["phase"] = phase
+    else:
+        offsets = arrival_times(args.requests, args.rate, args.burst_on_s,
+                                args.burst_off_s, rng)
     return plan, offsets
 
 
@@ -302,9 +382,15 @@ def boot_gateway(args, cfg):
     registry.warmup(args.size_list)
     jaxprobe.mark_warmup_done()
     slo_window = float((cfg.get("slo") or {}).get("window_s", 60.0) or 60.0)
+    autoscale = dict(cfg.serve.autoscale)
+    if getattr(args, "autoscale", None):
+        autoscale.update(parse_scale(args.autoscale))
     gw = Gateway(registry, port=0,
                  max_inflight=max(64, args.requests),
-                 slo_window_s=slo_window)
+                 slo_window_s=slo_window,
+                 autoscale=autoscale,
+                 priority=dict(cfg.serve.priority),
+                 stream_chunk_steps=int(cfg.serve.stream.chunk_steps))
     server = threading.Thread(target=gw.serve_forever, name="tg-gateway",
                               daemon=True)
     server.start()
@@ -456,7 +542,8 @@ def replay(base_url: str, plan, offsets, timeout_s: float,
                 break
             except Exception:
                 break
-        results[i] = {"cls": item["cls"], "status": status,
+        results[i] = {"cls": item["cls"], "phase": item.get("phase"),
+                      "status": status,
                       "ms": (time.perf_counter() - t_req) * 1e3,
                       "rid": echoed or item["rid"], "retries": retries}
 
@@ -474,9 +561,9 @@ def replay(base_url: str, plan, offsets, timeout_s: float,
     wall = time.perf_counter() - t0
     for i, item in enumerate(plan):   # a thread that never returned = error
         if results[i] is None:
-            results[i] = {"cls": item["cls"], "status": -1,
-                          "ms": timeout_s * 1e3, "rid": item["rid"],
-                          "retries": 0}
+            results[i] = {"cls": item["cls"], "phase": item.get("phase"),
+                          "status": -1, "ms": timeout_s * 1e3,
+                          "rid": item["rid"], "retries": 0}
     return results, wall
 
 
@@ -516,6 +603,62 @@ def class_stats(results):
     p50 = round(percentile(ok_all, 50), 3) if ok_all else None
     p99 = round(percentile(ok_all, 99), 3) if ok_all else None
     return classes, p50, p99
+
+
+def phase_stats(results, spec=None):
+    """Per-phase latency summary for profiled runs: overall AND
+    interactive-only (predict+session) p50/p99, plus — when a spec is
+    given — a per-phase SLO verdict over the phase's own route stats, so
+    the BENCH line proves interactive latency held through EVERY load
+    phase, not merely on average."""
+    from distegnn_tpu.obs import slo as slomod
+    from distegnn_tpu.obs.metrics import percentile
+
+    order, rows_by = [], {}
+    for r in results:
+        phase = r.get("phase")
+        if phase is None:
+            continue
+        if phase not in rows_by:
+            order.append(phase)
+            rows_by[phase] = []
+        rows_by[phase].append(r)
+    out = {}
+    for phase in order:
+        rows = rows_by[phase]
+        ok = sorted(r["ms"] for r in rows if 200 <= r["status"] < 400)
+        inter = sorted(r["ms"] for r in rows
+                       if r["cls"] in ("predict", "session")
+                       and 200 <= r["status"] < 400)
+        rec = {
+            "count": len(rows),
+            "ok": len(ok),
+            "p50_ms": round(percentile(ok, 50), 3) if ok else None,
+            "p99_ms": round(percentile(ok, 99), 3) if ok else None,
+            "interactive_p50_ms": (round(percentile(inter, 50), 3)
+                                   if inter else None),
+            "interactive_p99_ms": (round(percentile(inter, 99), 3)
+                                   if inter else None),
+        }
+        if spec is not None:
+            stats = {
+                "error_rate": sum(1 for r in rows if r["status"] >= 500
+                                  or r["status"] < 0) / len(rows),
+                "shed_rate": sum(1 for r in rows
+                                 if r["status"] == 429) / len(rows),
+            }
+            if inter:
+                stats["predict_p50_ms"] = percentile(inter, 50)
+                stats["predict_p99_ms"] = percentile(inter, 99)
+            roll = sorted(r["ms"] for r in rows if r["cls"] == "rollout"
+                          and 200 <= r["status"] < 400)
+            if roll:
+                stats["rollout_p50_ms"] = percentile(roll, 50)
+                stats["rollout_p99_ms"] = percentile(roll, 99)
+            rec["slo_pass"] = not slomod.breached(
+                slomod.evaluate(spec, stats))
+        out[phase] = rec
+    return out
 
 
 def slo_stats(results, prom_text: str):
@@ -601,6 +744,19 @@ def main(argv=None) -> int:
     ap.add_argument("--chaos", type=str, default=None,
                     help="serving fault schedule, e.g. 'kill@0.3:replica=0;"
                          "swap@1.0:ckpt=/p/b.ckpt' (in-process gateway only)")
+    ap.add_argument("--profile", type=str, default=None,
+                    choices=tuple(PROFILES),
+                    help="phased load shape (steady|ramp|spike10x); "
+                         "replaces the burst modulator and adds per-phase "
+                         "p50/p99 + SLO verdicts to the BENCH record")
+    ap.add_argument("--autoscale", type=str, default=None,
+                    help="enable the replica autoscaler on the in-process "
+                         "gateway: 'on' or serve.autoscale overrides as "
+                         "'key=val,...' (e.g. 'max_replicas=3,queue_high=2')")
+    ap.add_argument("--scale-settle-s", type=float, default=0.0,
+                    help="after the replay, wait up to this long for the "
+                         "autoscaler to shrink back to min_replicas before "
+                         "drain (one run then shows the full 1->N->1 cycle)")
     ap.add_argument("--max-retries", type=int, default=3,
                     help="client retries per request on 429/503 that carry "
                          "Retry-After (0 disables)")
@@ -623,6 +779,17 @@ def main(argv=None) -> int:
               "injectors reach into the live registry); drop --url",
               file=sys.stderr)  # noqa: obs-print
         return 2
+    if args.autoscale:
+        if args.url:
+            print("traffic_gen: --autoscale configures the in-process "
+                  "gateway; drop --url (a remote gateway scales itself)",
+                  file=sys.stderr)  # noqa: obs-print
+            return 2
+        try:
+            parse_scale(args.autoscale)
+        except ValueError as exc:
+            print(f"traffic_gen: {exc}", file=sys.stderr)  # noqa: obs-print
+            return 2
 
     from distegnn_tpu import obs
     from distegnn_tpu.config import ConfigDict, _DEFAULTS, load_config
@@ -673,6 +840,20 @@ def main(argv=None) -> int:
                            max_retries=args.max_retries)
     if chaos_thread is not None:
         chaos_thread.join(timeout=args.timeout_s + 60.0)
+    scale_state = None
+    if gw is not None and gw.autoscaler.enable:
+        # hold the gateway open while the calm-streak logic walks the fleet
+        # back down, so this run's event stream carries scale_down too.
+        # calm_rounds >= 1 guards the at-min check: it is 0 while an
+        # up-trigger is firing or a grow (warmup included) is still inside
+        # the tick lock, so the loop can't slip out mid-scale-up
+        deadline = time.perf_counter() + max(0.0, args.scale_settle_s)
+        while time.perf_counter() < deadline:
+            if all(s["replicas"] <= s["min"] and s["calm_rounds"] >= 1
+                   for s in gw.autoscaler.status().values()):
+                break
+            time.sleep(0.25)
+        scale_state = gw.autoscaler.status()
     prom_text = scrape_metrics(base_url)
     if gw is not None:
         gw.drain()
@@ -684,6 +865,7 @@ def main(argv=None) -> int:
     stats = slo_stats(results, prom_text)
     spec = load_slo_spec(args, cfg)
     slo_results = slomod.evaluate(spec, stats)
+    phases = phase_stats(results, spec) if args.profile else None
     print(slomod.verdict_table(slo_results, source="traffic_gen"),
           end="", file=sys.stderr)  # noqa: obs-print
 
@@ -704,6 +886,9 @@ def main(argv=None) -> int:
         "lost": sum(1 for r in results if r["status"] < 0),
         "retries_total": sum(r.get("retries", 0) for r in results),
         "chaos": chaos_record or None,
+        "profile": args.profile,
+        "phases": phases,
+        "autoscale": scale_state,
         "batch_fill": stats.get("batch_fill"),
         "session_hit_rate": stats.get("session_hit_rate"),
         "offered_rate": args.rate,

@@ -359,6 +359,26 @@ _DEFAULTS: dict = {
         "batch_fill_min": None,   # floor on filled/capacity slots
         "session_hit_min": None,  # floor on session prep-cache hit rate
     },
+    # continuous train->serve promotion (distegnn_tpu/promote,
+    # docs/SERVING.md "Continuous promotion"): the trainer publishes each
+    # rotated checkpoint as a candidate into watch_dir; the gateway's
+    # Promoter canaries it on one quarantined replica, replays a shadow
+    # sample of live traffic against it, and promotes fleet-wide or rolls
+    # back on the SLO window + prediction-drift gates.
+    "promote": {
+        "enable": False,          # gateway-side promoter control loop
+        "publish": False,         # trainer-side candidate publishing
+        "watch_dir": "",          # conveyor directory (shared by both ends)
+        "model": "",              # registry entry to promote ("" = first)
+        "interval_s": 1.0,        # promoter poll cadence
+        "history": 4,             # candidates retained in watch_dir
+        "shadow_sample": 0.25,    # fraction of live predicts teed to canary
+        "min_shadow": 8,          # shadow comparisons required per verdict
+        "max_shadow_inflight": 8, # outstanding shadow submits ceiling
+        "gate_timeout_s": 30.0,   # max canary window before forced verdict
+        "drift_ceiling": 0.05,    # per-rung mean relative divergence ceiling
+        "max_error_rate": 0.0,    # SLO-window 5xx ceiling during canary
+    },
     "log": {
         "log_dir": "./logs",
         "test_interval": 2,
@@ -774,6 +794,49 @@ def validate_config(cfg: ConfigDict) -> None:
                 or any(int(n) < 2 for n in nodes)):
             raise ValueError("serve.gateway.warmup_nodes must be a "
                              "non-empty list of node counts >= 2")
+    lg = cfg.get("log")
+    if lg is not None:
+        if not isinstance(lg.get("log_dir", ""), str):
+            raise ValueError("log.log_dir must be a string path")
+        if int(lg.get("test_interval", 2)) < 1:
+            raise ValueError("log.test_interval must be >= 1")
+        if not isinstance(lg.get("check_consistency", True), bool):
+            raise ValueError("log.check_consistency must be a boolean")
+        if int(lg.get("trace_epoch", 0) or 0) < 0:
+            raise ValueError("log.trace_epoch must be >= 0")
+    pm = cfg.get("promote")
+    if pm is not None:
+        if not isinstance(pm, Mapping):
+            raise ValueError("promote must be null or a mapping of "
+                             "promotion-conveyor knobs")
+        pmknown = ("enable", "publish", "watch_dir", "model", "interval_s",
+                   "history", "shadow_sample", "min_shadow",
+                   "max_shadow_inflight", "gate_timeout_s", "drift_ceiling",
+                   "max_error_rate")
+        for key in pm:
+            if key not in pmknown:
+                raise ValueError(f"promote: unknown key {key!r} "
+                                 f"(accepted: {', '.join(pmknown)})")
+        for flag in ("enable", "publish"):
+            if not isinstance(pm.get(flag, False), bool):
+                raise ValueError(f"promote.{flag} must be a boolean")
+        for skey in ("watch_dir", "model"):
+            if not isinstance(pm.get(skey, ""), str):
+                raise ValueError(f"promote.{skey} must be a string")
+        for key in ("interval_s", "gate_timeout_s", "drift_ceiling"):
+            if float(pm.get(key, 1.0)) <= 0:
+                raise ValueError(f"promote.{key} must be > 0")
+        for key in ("history", "min_shadow", "max_shadow_inflight"):
+            if int(pm.get(key, 1)) < 1:
+                raise ValueError(f"promote.{key} must be >= 1")
+        if not 0.0 < float(pm.get("shadow_sample", 0.25)) <= 1.0:
+            raise ValueError("promote.shadow_sample must be in (0, 1]")
+        if float(pm.get("max_error_rate", 0.0)) < 0:
+            raise ValueError("promote.max_error_rate must be >= 0")
+        if ((pm.get("enable") or pm.get("publish"))
+                and not str(pm.get("watch_dir", "")).strip()):
+            raise ValueError("promote.watch_dir is required when "
+                             "promote.enable or promote.publish is set")
 
 
 def derive_runtime_fields(cfg: ConfigDict, world_size: Optional[int] = None) -> ConfigDict:

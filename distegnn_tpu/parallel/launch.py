@@ -298,8 +298,13 @@ def run_distributed(config):
             config.model.checkpoint, state, config=config)
         obs.log(f"Checkpoint loaded from {config.model.checkpoint} (epoch {start_epoch})")
     # coordinated-restore barrier: every host must have adopted the same
-    # resume coordinates before any psum'd step runs (no-op single-process)
-    verify_resume_consensus(start_epoch, start_step_in_epoch)
+    # resume coordinates before any psum'd step runs (no-op single-process);
+    # the local path rides the typed error so a consensus failure names a
+    # concrete checkpoint to diff against the lagging hosts
+    verify_resume_consensus(
+        start_epoch, start_step_in_epoch,
+        path=(resumed.path if resumed is not None
+              else (config.model.checkpoint or None)))
 
     is_fast = config.model.model_name.startswith("Fast")
     mmd_w = config.train.mmd.weight if is_fast else 0.0

@@ -1,0 +1,26 @@
+"""Continuous train->serve promotion conveyor (docs/SERVING.md
+"Continuous promotion").
+
+The trainer end publishes every rotated checkpoint as a *candidate* —
+``step_<n>.ckpt`` plus a JSON manifest — into a watched directory
+(:mod:`distegnn_tpu.promote.publish`). The serving end runs a control loop
+(:mod:`distegnn_tpu.promote.promoter`) that canaries each new candidate on
+one quarantined replica, replays a shadow sample of live traffic against
+it, and promotes fleet-wide or rolls back on two gates: the gateway's
+rolling SLO window and the per-rung prediction-drift gauge
+(:mod:`distegnn_tpu.promote.drift`).
+"""
+
+from distegnn_tpu.promote.drift import DriftGauge
+from distegnn_tpu.promote.promoter import Promoter
+from distegnn_tpu.promote.publish import (CandidatePublisher, config_hash,
+                                          list_candidates, read_candidate)
+
+__all__ = [
+    "CandidatePublisher",
+    "DriftGauge",
+    "Promoter",
+    "config_hash",
+    "list_candidates",
+    "read_candidate",
+]

@@ -230,8 +230,10 @@ class ReplicaAutoscaler:
         sizes = [(b.n, b.e) for b in entry.warmed]
         for _ in range(count):
             try:
-                entry.replicas.add_replica(entry.replica_factory,
-                                           warm_sizes=sizes)
+                # entry.add_replica (not the raw set) so a blue/green swap
+                # racing the build cannot leave the new replica on the
+                # retired version — it re-pins under the swap lock
+                entry.add_replica(warm_sizes=sizes)
             except Exception as exc:
                 obs.event("gateway/scale_blocked", model=name,
                           direction="up", reason="spawn_failed",

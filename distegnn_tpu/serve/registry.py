@@ -128,6 +128,36 @@ class ModelEntry:
     def alive(self) -> bool:
         return self.replicas.alive()
 
+    def add_replica(self, warm_sizes=None):
+        """The autoscaler's scale-up unit. ``replica_factory`` reads the
+        entry's params/checkpoint at build time, so a blue/green swap racing
+        the build could hand the new replica a snapshot the flip loop
+        already retired — and never revisit it (the loop iterates the list
+        as it was while the replica was still unappended). The post-append
+        re-pin below runs under the swap lock, where the live version is
+        stable, closing that window for every interleaving."""
+        if self.replica_factory is None:
+            raise RuntimeError(f"model '{self.name}' has no replica "
+                               f"factory; its set is not growable")
+        replica = self.replicas.add_replica(self.replica_factory,
+                                            warm_sizes=warm_sizes)
+        with self._swap_lock:   # blocks until any in-flight swap lands
+            ck = getattr(replica, "current_checkpoint", None)
+            if ck is not None or getattr(replica, "_ckpt_lock",
+                                         None) is not None:
+                stale = str(ck) != str(self.checkpoint)
+            else:
+                eng = getattr(replica, "engine", None)
+                stale = (eng is not None
+                         and eng.params is not self.engine.params)
+            if stale:
+                replica.swap_params(str(self.checkpoint),
+                                    self.engine.params, list(self.warmed))
+                obs.event("gateway/scale_up_repin", model=self.name,
+                          replica=replica.idx,
+                          version=self.params_version)
+        return replica
+
     @property
     def rollout_enabled(self) -> bool:
         return self.engine.rollout_enabled

@@ -539,6 +539,11 @@ class ReplicaSet:
         self._rr = 0
         self._lock = threading.Lock()
         self._supervised = False
+        # replica indices pinned OUT of live round-robin (the promotion
+        # conveyor's canary slice): still supervised, still restartable,
+        # but _choose never routes live traffic to them — shadow traffic is
+        # submitted straight to the replica's own queue
+        self._quarantined: Set[int] = set()
         # monotonic index source for replicas added LIVE (autoscaler
         # scale-up): indices are never renumbered or reused, so per-replica
         # gauges and health rows keyed on idx can't alias across a
@@ -640,7 +645,8 @@ class ReplicaSet:
         the retired replica, or None when only one running replica
         remains."""
         with self._lock:
-            running = [r for r in self.replicas if r.state == "running"]
+            running = [r for r in self.replicas if r.state == "running"
+                       and r.idx not in self._quarantined]
             if len(running) <= 1:
                 return None
             victim = running[-1]
@@ -666,6 +672,33 @@ class ReplicaSet:
                 self.replicas.remove(victim)
         return victim
 
+    # ---- canary quarantine (promotion conveyor surface) ------------------
+    def quarantine(self, idx: int) -> bool:
+        """Pin replica ``idx`` out of live round-robin (the promotion
+        canary slice). Refused (returns False) when it would leave no other
+        healthy live replica — a single-replica fleet has no slice to
+        spare. Idempotent; the replica stays supervised throughout."""
+        with self._lock:
+            target = next((r for r in self.replicas if r.idx == idx), None)
+            if target is None:
+                return False
+            others = [r for r in self.replicas
+                      if r.idx != idx and r.idx not in self._quarantined
+                      and r.healthy()]
+            if not others:
+                return False
+            self._quarantined.add(idx)
+            return True
+
+    def release(self, idx: int) -> None:
+        """Return a quarantined replica to live rotation. Idempotent."""
+        with self._lock:
+            self._quarantined.discard(idx)
+
+    def quarantined(self) -> Set[int]:
+        with self._lock:
+            return set(self._quarantined)
+
     def supports_streaming(self) -> bool:
         """True when some member executes in-process (a plain RequestQueue)
         — the chunk conduit can't cross the worker IPC channel, so the
@@ -690,6 +723,7 @@ class ReplicaSet:
         with self._lock:
             cands = [r for r in self.replicas
                      if r.idx not in exclude and r.healthy()
+                     and r.idx not in self._quarantined
                      and not (thread_only and isinstance(r.queue,
                                                          WorkerQueue))]
             if not cands:
@@ -781,7 +815,9 @@ class ReplicaSet:
 
     # ---- health / hints ---------------------------------------------------
     def available(self) -> int:
-        return sum(1 for r in self.replicas if r.healthy())
+        with self._lock:
+            return sum(1 for r in self.replicas
+                       if r.healthy() and r.idx not in self._quarantined)
 
     def health(self) -> List[dict]:
         rows = []
@@ -790,6 +826,7 @@ class ReplicaSet:
                    "alive": r.queue.alive(), "failures": r.failures,
                    "restarts": r.restarts, "inflight": r.inflight_count(),
                    "depth": r.queue.depth(), "last_reason": r.last_reason,
+                   "quarantined": r.idx in self._quarantined,
                    "backend": r.backend}
             row.update(r.backend_detail())  # may downgrade backend: degraded
             rows.append(row)

@@ -301,7 +301,8 @@ class Gateway:
                  slo_window_s: float = 60.0,
                  autoscale: Optional[dict] = None,
                  priority: Optional[dict] = None,
-                 stream_chunk_steps: int = 8):
+                 stream_chunk_steps: int = 8,
+                 promote: Optional[dict] = None):
         from distegnn_tpu.obs.slo import SLOMonitor
 
         self.registry = registry
@@ -344,6 +345,14 @@ class Gateway:
             registry, self.slo_monitor, config=autoscale,
             metrics_registry=self._reg)
         self.autoscaler.start()
+        # the promotion conveyor's serving end (no-op unless promote.enable):
+        # watches the candidate directory, canaries on a quarantined replica,
+        # and reads its shadow sample off this gateway's predict hot path
+        from distegnn_tpu.promote.promoter import Promoter
+        self.promoter = Promoter(
+            registry, self.slo_monitor, config=promote,
+            metrics_registry=self._reg)
+        self.promoter.start()
         self.httpd = _Server((host, int(port)), _make_handler(self))
 
     # ---- addresses -------------------------------------------------------
@@ -386,8 +395,10 @@ class Gateway:
             self._draining = True
         self._accepting = False
         self._ready_gauge.set(0.0)
-        # the autoscaler must not grow/shrink a fleet that is draining
+        # the autoscaler must not grow/shrink a fleet that is draining, and
+        # the promoter must not start (or hold) a canary across the drain
         self.autoscaler.stop()
+        self.promoter.stop()
         obs.event("gateway/drain_begin", inflight=self._inflight)
         # every admitted future resolves; models drain CONCURRENTLY, each
         # bounded by the grace budget (registry.stop). Signature-aware so a
@@ -412,6 +423,7 @@ class Gateway:
 
     def close(self) -> None:
         self.autoscaler.stop()
+        self.promoter.stop()
         self.httpd.server_close()
 
     def ready(self) -> bool:
@@ -491,10 +503,14 @@ class Gateway:
             health = self.registry.health()
             scale = (self.autoscaler.status()
                      if self.autoscaler.enable else None)
+            promo = (self.promoter.status()
+                     if self.promoter.enable else None)
             if fully_ready:
                 body = {"ready": True, "models": health}
                 if scale is not None:
                     body["autoscale"] = scale
+                if promo is not None:
+                    body["promote"] = promo
                 return self._send_json(h, 200, body)
             if self.registry.any_ready():
                 # degraded: the broken model 503s on its own routes while
@@ -502,6 +518,8 @@ class Gateway:
                 body = {"ready": True, "degraded": True, "models": health}
                 if scale is not None:
                     body["autoscale"] = scale
+                if promo is not None:
+                    body["promote"] = promo
                 return self._send_json(h, 200, body)
             return self._send_json(h, 503, {
                 "ready": False,
@@ -674,6 +692,12 @@ class Gateway:
                 h, 503, {"error": str(exc), "type": "ModelUnavailable",
                          "model": exc.model},
                 retry_after=exc.retry_after_s)
+        if self.promoter.enable:
+            # promotion shadow tee: mirror this (graph, live output) pair to
+            # the canary replica. Sampled + bounded inside tee, and the
+            # shadow response never reaches this client — the live `out` is
+            # already in hand and is what gets encoded below.
+            self.promoter.tee(name, graph, bucket, rid, out)
         if perm is not None:
             # the session plan served the model a Morton-relabeled graph;
             # answer in the client's original node order
@@ -983,6 +1007,8 @@ class Gateway:
             self._inflight_gauge.set(self._inflight)
         self._ready_gauge.set(1.0 if self.ready() else 0.0)
         self.slo_monitor.export(self._reg, self.registry)
+        if self.promoter.enable:
+            self.promoter.export()   # conveyor + drift gauges stay fresh
         # per-replica health gauges: 1 = running with a live dispatcher
         for name, entry in self.registry.items():
             for rh in entry.replicas.health():

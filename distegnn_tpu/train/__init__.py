@@ -3,6 +3,7 @@
 from distegnn_tpu.train.checkpoint import (
     CheckpointCorruptError,
     RestoredRun,
+    ResumeConsensusError,
     find_resume_checkpoint,
     restore_checkpoint,
     restore_for_resume,
@@ -42,6 +43,7 @@ __all__ = [
     "find_resume_checkpoint",
     "verify_checkpoint",
     "CheckpointCorruptError",
+    "ResumeConsensusError",
     "RestoredRun",
     "train",
     "run_epoch_train",

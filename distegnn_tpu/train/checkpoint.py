@@ -127,8 +127,39 @@ def check_mesh_restore_compat(payload: dict, config=None) -> None:
                 "plain load)")
 
 
+class ResumeConsensusError(RuntimeError):
+    """Multi-host resume diverged: hosts adopted different (epoch,
+    step_in_epoch) coordinates from their local filesystem views. Carries
+    enough structure for tooling (and the operator) to see WHO is behind:
+
+    - ``coords``: [(epoch, step_in_epoch)] per process index;
+    - ``lagging``: process indices whose coordinates trail the newest view
+      (the hosts whose checkpoint directory is stale);
+    - ``local_path``: the checkpoint THIS process resolved (one concrete
+      path to diff against the lagging hosts' directories).
+    """
+
+    def __init__(self, coords, lagging, local_path=None):
+        self.coords = [tuple(int(v) for v in row) for row in coords]
+        self.lagging = sorted(int(i) for i in lagging)
+        self.local_path = local_path
+        latest = max(self.coords)
+        views = ", ".join(
+            f"process {i}: epoch={e} step_in_epoch={s}"
+            for i, (e, s) in enumerate(self.coords))
+        behind = ", ".join(f"process {i}" for i in self.lagging)
+        where = (f" (this process resolved {local_path!r})"
+                 if local_path else "")
+        super().__init__(
+            f"resume consensus failure: {behind} lag(s) behind the newest "
+            f"view epoch={latest[0]} step_in_epoch={latest[1]} — a "
+            f"half-propagated checkpoint directory on the lagging host(s) "
+            f"is the usual cause. Views: {views}{where}. Propagate the "
+            "same state_dict/ contents to every host, then relaunch.")
+
+
 def verify_resume_consensus(epoch: int, step_in_epoch: int,
-                            allgather=None) -> None:
+                            allgather=None, path: Optional[str] = None) -> None:
     """Multi-host coordinated-restore barrier (closes the docs/ROBUSTNESS.md
     'Known gap'): each process resolves its resume checkpoint independently
     from its own filesystem view, so a half-propagated checkpoint directory
@@ -142,7 +173,9 @@ def verify_resume_consensus(epoch: int, step_in_epoch: int,
     the local ``np.ndarray([epoch, step_in_epoch])`` and returning the
     [n_process, 2] stack. Default uses
     ``jax.experimental.multihost_utils.process_allgather``; single-process
-    runs with the default are a no-op."""
+    runs with the default are a no-op. ``path`` is the resume checkpoint
+    THIS process resolved — it rides the typed error so the operator has a
+    concrete path to diff against the lagging hosts."""
     if allgather is None:
         if jax.process_count() == 1:
             return
@@ -157,14 +190,13 @@ def verify_resume_consensus(epoch: int, step_in_epoch: int,
     obs.event("resume/consensus", epoch=int(epoch),
               step_in_epoch=int(step_in_epoch), n_views=len(uniq))
     if len(uniq) > 1:
-        views = ", ".join(
-            f"process {i}: epoch={int(r[0])} step_in_epoch={int(r[1])}"
-            for i, r in enumerate(coords))
-        raise RuntimeError(
-            "resume consensus failure: hosts adopted different resume "
-            f"coordinates ({views}). A half-propagated checkpoint directory "
-            "is the usual cause — make every host see the same state_dict/ "
-            "contents, then relaunch.")
+        latest = max(uniq)
+        lagging = [i for i, row in enumerate(coords)
+                   if (int(row[0]), int(row[1])) < latest]
+        obs.event("resume/consensus_failure", lagging=lagging,
+                  latest=list(latest),
+                  views=[[int(v) for v in row] for row in coords])
+        raise ResumeConsensusError(coords, lagging, local_path=path)
 
 
 def _to_leaves(tree) -> list:
@@ -312,6 +344,18 @@ def rotate_checkpoints(ckpt_dir: str, keep: int) -> List[str]:
             removed.append(p)
         except OSError:
             pass
+    if steps:
+        # rotation was silent before the promotion conveyor landed; the
+        # event makes publish latency attributable in obs_report waterfalls
+        # (ckpt/save -> ckpt/rotate -> promote/publish)
+        newest_step, newest_path = steps[-1]
+        try:
+            newest_bytes = os.path.getsize(newest_path)
+        except OSError:
+            newest_bytes = -1
+        obs.event("ckpt/rotate", step=newest_step, bytes=newest_bytes,
+                  kept=min(len(steps) - len(removed), max(int(keep), 1)),
+                  removed=len(removed))
     return removed
 
 

@@ -138,7 +138,7 @@ class CadenceSaver:
 
     def __init__(self, ckpt_dir: str, interval_s: float, keep: int,
                  config: Optional[dict], seed: Optional[int],
-                 enabled: bool = True):
+                 enabled: bool = True, publisher=None):
         self.ckpt_dir = ckpt_dir
         self.interval_s = float(interval_s or 0)
         self.keep = max(int(keep), 1)
@@ -147,6 +147,12 @@ class CadenceSaver:
         self.enabled = enabled and self.interval_s > 0
         self._last = time.monotonic()
         self.saves = 0
+        # promotion conveyor (promote.publish): after each save+rotation the
+        # checkpoint is republished as a serving candidate. None = training
+        # island only. Latest eval loss rides the candidate manifest so the
+        # promoter can attribute a candidate to its validation quality.
+        self.publisher = publisher
+        self.last_val_loss: Optional[float] = None
 
     def maybe_save(self, state, completed_epoch: int, step_in_epoch: int) -> None:
         if not self.enabled or time.monotonic() - self._last < self.interval_s:
@@ -161,6 +167,16 @@ class CadenceSaver:
         rotate_checkpoints(self.ckpt_dir, self.keep)
         self._last = time.monotonic()
         self.saves += 1
+        if self.publisher is not None:
+            try:
+                self.publisher.publish(path, step=int(state.step),
+                                       val_loss=self.last_val_loss,
+                                       config=self.config)
+            except Exception as exc:
+                # the conveyor never stops training: a full/unwritable
+                # watch_dir just delays promotion to the next rotation
+                obs.log(f"promote: candidate publish failed for step "
+                        f"{int(state.step)}: {exc!r}")
 
 
 def run_epoch_train(train_step: Callable, state, loader, seed: int, epoch: int,
@@ -346,10 +362,22 @@ def train(
 
     cfg_dict = config.to_dict() if hasattr(config, "to_dict") else dict(config)
     guard = PreemptionGuard().install()
+    # trainer end of the promotion conveyor (docs/SERVING.md "Continuous
+    # promotion"): every rotated cadence checkpoint is republished as a
+    # candidate the serving gateway's promoter can canary. process 0 only —
+    # same ownership rule as the checkpoints themselves.
+    publisher = None
+    pm_cfg = config.get("promote") or {}
+    if (is_main and log and pm_cfg.get("publish")
+            and str(pm_cfg.get("watch_dir", "")).strip()):
+        from distegnn_tpu.promote.publish import CandidatePublisher
+
+        publisher = CandidatePublisher(str(pm_cfg["watch_dir"]),
+                                       history=int(pm_cfg.get("history", 4)))
     cadence = CadenceSaver(
         ckpt_dir, train_cfg.get("checkpoint_interval_s", 0),
         train_cfg.get("keep_checkpoints", 3), cfg_dict, seed,
-        enabled=is_main and log)
+        enabled=is_main and log, publisher=publisher)
     retries_left = int(train_cfg.get("divergence_retries", 0) or 0)
     lr_decay = float(train_cfg.get("divergence_lr_decay", 0.5) or 0.5)
     lr_scale = 1.0
@@ -517,6 +545,9 @@ def train(
                              dur_s=round(time.perf_counter() - t_eval, 4),
                              loss_valid=float(loss_valid),
                              loss_test=float(loss_test))
+                if np.isfinite(loss_valid):
+                    # candidates published after this eval carry this loss
+                    cadence.last_val_loss = float(loss_valid)
                 if not warmup_marked:
                     # eval_step compiles at the FIRST eval epoch — only once
                     # both train and eval programs have run is every further

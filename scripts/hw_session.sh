@@ -568,6 +568,48 @@ EOF
 export -f elasticity_spike_and_check
 run_bounded elasticity_spike elasticity_spike_and_check
 
+# 3a''''. promotion conveyor drill: the continuous train->serve promotion
+#         path (docs/SERVING.md "Continuous promotion") with process
+#         workers, all inside ONE traffic_gen run — candidates published
+#         under live traffic, the first promoting through canary + shadow,
+#         a trainer SIGKILL mid-publish (orphan tmp only, no torn
+#         candidate), a real canary-worker SIGKILL mid-promotion
+#         (immediate rollback), and an injected-drift candidate rolled
+#         back on the gauge. The done-marker keys on the drill's own
+#         verdict plus zero lost requests and a coherent fleet version.
+promote_and_check() {
+  local stamp obsdir
+  stamp=$(date -u +%Y%m%dT%H%M%S)
+  obsdir=logs/traffic_gen/hw_promote_$stamp
+  python scripts/traffic_gen.py --config_path configs/nbody_promote.yaml \
+    --promote --requests 80 --rate 20 --mix "predict=0.8,session=0.2" \
+    --sizes 24,48 --sessions 4 --seed 7 --timeout-s 300 \
+    --workers process \
+    --obs-dir "$obsdir" \
+    | tee /tmp/promote_last.json || return 1
+  python - <<'EOF' || return 1
+import json
+line = [l for l in open('/tmp/promote_last.json') if l.strip().startswith('{')][-1]
+rec = json.loads(line)
+pr = rec.get('promote') or {}
+ph = pr.get('phases') or {}
+ok = (pr.get('ok') is True
+      and (ph.get('promote') or {}).get('outcome') == 'promoted'
+      and (ph.get('trainer_kill') or {}).get('ok') is True
+      and (ph.get('canary_kill') or {}).get('reason') == 'canary_died'
+      and (ph.get('drift') or {}).get('reason') == 'drift'
+      and (pr.get('readyz') or {}).get('fleet_coherent') is True
+      and rec.get('completed', 0) == rec.get('requests', -1)
+      and rec.get('lost', 1) == 0)
+raise SystemExit(0 if ok else 1)
+EOF
+  mkdir -p docs/artifacts
+  cp /tmp/promote_last.json "docs/artifacts/promote_drill_$stamp.json"
+  python scripts/obs_report.py "$obsdir/obs/events.jsonl"
+}
+export -f promote_and_check
+run_bounded promote promote_and_check
+
 # 3b. machine roofline probe (minutes): copy/matmul/gather/scatter ceilings
 #     + analytic step floor — pairs with the new hbm_gbps field in the bench
 #     line (VERDICT r4 #7) to place every lowering on the memory roofline.

@@ -89,7 +89,8 @@ def main(argv=None) -> int:
                            or 60.0),
         autoscale=dict(s.autoscale),
         priority=dict(s.priority),
-        stream_chunk_steps=int(s.stream.chunk_steps))
+        stream_chunk_steps=int(s.stream.chunk_steps),
+        promote=dict(cfg.get("promote") or {}))
     gateway.install_signal_handlers()
     host, port = gateway.address
     obs.log(f"gateway: listening on http://{host}:{port} "

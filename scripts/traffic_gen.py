@@ -390,7 +390,8 @@ def boot_gateway(args, cfg):
                  slo_window_s=slo_window,
                  autoscale=autoscale,
                  priority=dict(cfg.serve.priority),
-                 stream_chunk_steps=int(cfg.serve.stream.chunk_steps))
+                 stream_chunk_steps=int(cfg.serve.stream.chunk_steps),
+                 promote=dict(cfg.get("promote") or {}))
     server = threading.Thread(target=gw.serve_forever, name="tg-gateway",
                               daemon=True)
     server.start()
@@ -497,6 +498,251 @@ def run_chaos(events, t0: float, registry, base_url: str, models,
             outcome.update(ok=False, error=repr(exc))
         obs.event("chaos/inject", **outcome)
         record.append(outcome)
+
+
+# ---- the promotion conveyor drill -------------------------------------------
+
+def publish_child_main(spec_json: str) -> int:
+    """The drill's stand-in trainer process: publish candidates through the
+    REAL CandidatePublisher (tmp+fsync+rename, manifest last). A plan item
+    with ``hang: true`` simulates dying INSIDE the atomic write — it leaves
+    an orphan ``.tmp.`` file in the watch dir, announces itself on stdout,
+    and waits for the parent's SIGKILL; the conveyor invariant under test is
+    that no manifest ever points at a partial checkpoint."""
+    import tempfile
+
+    spec = json.loads(spec_json)
+    watch = spec["watch_dir"]
+    from distegnn_tpu.promote.publish import CandidatePublisher
+
+    pub = CandidatePublisher(watch, history=int(spec.get("history", 4)))
+    for item in spec["plan"]:
+        delay = float(item.get("delay", 0.0))
+        if delay > 0:
+            time.sleep(delay)
+        step = int(item["step"])
+        if item.get("hang"):
+            fd, _ = tempfile.mkstemp(
+                dir=watch, prefix=f"step_{step:010d}.ckpt.tmp.")
+            os.write(fd, b"partial-checkpoint-bytes")
+            print(f"TG-PUBLISH-HANG {step}", flush=True)
+            time.sleep(600.0)
+            os.close(fd)
+            return 3  # unreachable under the drill's SIGKILL
+        pub.publish(item["ckpt"], step=step, val_loss=item.get("val_loss"))
+        print(f"TG-PUBLISHED {step}", flush=True)
+    return 0
+
+
+def run_promote_drill(args, gw, registry, model, base_url, feat_nf,
+                      edge_attr_nf, record) -> None:
+    """The continuous-promotion chaos drill, run alongside the replay:
+
+      1. a publisher CHILD PROCESS lands a good candidate -> it promotes
+         fleet-wide through canary + shadow gates;
+      2. a second publisher is SIGKILLed mid-publish (tmp file open, no
+         manifest) -> the conveyor must not move;
+      3. a third candidate's canary replica is killed mid-promotion
+         (SIGKILL under process workers) -> immediate canary_died rollback,
+         the supervisor restores the replica;
+      4. a drift-injected candidate -> the drift gauge rolls it back.
+
+    Fills ``record`` (the BENCH line's ``promote`` field) with per-phase
+    outcomes, the orphan-sweep proof, and the /readyz fleet-coherence bit.
+    Never raises — a wedged drill lands in ``record['error']``."""
+    import signal
+    import subprocess
+    import urllib.error
+    import urllib.request
+    from types import SimpleNamespace
+
+    import jax
+
+    from distegnn_tpu import obs
+    from distegnn_tpu.promote.publish import candidate_manifest_name
+    from distegnn_tpu.serve.buckets import synthetic_graph
+    from distegnn_tpu.testing import serve_faults
+    from distegnn_tpu.train.checkpoint import save_checkpoint
+
+    promoter = gw.promoter
+    entry = registry.get(model)
+    watch = promoter.watch_dir
+    stage = os.path.join(os.path.dirname(watch) or ".", "promote_ckpts")
+    os.makedirs(stage, exist_ok=True)
+    record.update(ok=False, phases={}, published=0)
+    children = []
+
+    def save_scaled(name, scale, shift=0.0):
+        params = jax.tree.map(lambda x: x * scale + shift,
+                              entry.engine.params)
+        path = os.path.join(stage, name)
+        save_checkpoint(path, SimpleNamespace(params=params, opt_state={},
+                                              step=0), epoch=0)
+        return path
+
+    def spawn(plan):
+        spec = json.dumps({"watch_dir": watch, "plan": plan})
+        proc = subprocess.Popen(
+            [sys.executable, os.path.abspath(__file__),
+             "--publish-child", spec],
+            stdout=subprocess.PIPE, stderr=subprocess.DEVNULL, text=True)
+        children.append(proc)
+        return proc
+
+    probe_body = predict_payload(synthetic_graph(
+        min(args.size_list), seed=4321, feat_nf=feat_nf,
+        edge_attr_nf=edge_attr_nf))
+
+    def probe():
+        # gate fuel, not scored traffic: shadow evidence must keep
+        # accumulating even after the replay plan runs dry
+        req = urllib.request.Request(
+            base_url.rstrip("/") + f"/v1/models/{model}/predict",
+            data=probe_body,
+            headers={"Content-Type": "application/json",
+                     "X-Request-Id": "tg-promote-probe"}, method="POST")
+        try:
+            with urllib.request.urlopen(req, timeout=30.0) as resp:
+                resp.read()
+        except Exception:
+            pass
+
+    def outcome_for(step):
+        for r in promoter.results:
+            if r.get("step") == step:
+                return r
+        return None
+
+    def wait_for(pred, timeout_s, poke=False):
+        deadline = time.perf_counter() + timeout_s
+        while time.perf_counter() < deadline:
+            if pred():
+                return True
+            if poke:
+                probe()
+            time.sleep(0.05)
+        return bool(pred())
+
+    def healthy_replicas():
+        return sum(1 for r in entry.replicas.replicas if r.healthy())
+
+    def readyz():
+        try:
+            with urllib.request.urlopen(
+                    base_url.rstrip("/") + "/readyz", timeout=10.0) as resp:
+                return json.loads(resp.read().decode())
+        except urllib.error.HTTPError as e:
+            try:
+                return json.loads(e.read().decode() or "{}")
+            except ValueError:
+                return {}
+        except Exception:
+            return {}
+
+    try:
+        good1 = save_scaled("good1.ckpt", 1.0001)
+        good2 = save_scaled("good2.ckpt", 1.0002)
+        # big enough to breach the drift ceiling by an order of magnitude,
+        # small enough to stay finite (larger scales overflow the net and
+        # get rejected by the canary finiteness check instead)
+        drifted = save_scaled("drift.ckpt", 2.25)
+
+        # phase 1: good candidate promotes fleet-wide
+        proc = spawn([{"step": 10, "ckpt": good1, "val_loss": 0.5}])
+        proc.wait(timeout=120)
+        record["published"] += 1
+        wait_for(lambda: outcome_for(10), 30.0, poke=True)
+        o1 = dict(outcome_for(10) or {})
+        record["phases"]["promote"] = o1
+        promote_ok = o1.get("outcome") == "promoted"
+
+        # phase 2: trainer SIGKILLed mid-publish — orphan tmp, no manifest,
+        # conveyor position unchanged
+        before = promoter.last_step
+        proc = spawn([{"step": 20, "hang": True}])
+        marker = proc.stdout.readline()
+        os.kill(proc.pid, signal.SIGKILL)
+        proc.wait(timeout=60)
+        time.sleep(3 * promoter.interval_s + 0.1)
+        orphan = any(".tmp." in f for f in os.listdir(watch))
+        manifest20 = os.path.exists(
+            os.path.join(watch, candidate_manifest_name(20)))
+        kill_ok = orphan and not manifest20 and promoter.last_step == before
+        record["phases"]["trainer_kill"] = {
+            "marker": marker.strip(), "orphan_tmp": orphan,
+            "manifest_appeared": manifest20,
+            "conveyor_moved": promoter.last_step != before, "ok": kill_ok}
+
+        # phase 3: kill the canary replica mid-promotion (SIGKILL when the
+        # replica is a worker child) -> immediate canary_died rollback
+        wait_for(lambda: healthy_replicas() >= 2, 30.0)
+        hold = promoter.min_shadow
+        promoter.min_shadow = 10 ** 6  # pin the canary open for the kill
+        killed_via = None
+        try:
+            proc = spawn([{"step": 30, "ckpt": good2, "val_loss": 0.4}])
+            proc.wait(timeout=120)
+            record["published"] += 1
+
+            def canary_up():
+                c = promoter.status().get("canary")
+                return c is not None and c["step"] == 30
+
+            wait_for(canary_up, 20.0, poke=True)
+            c = promoter.status().get("canary") or {}
+            idx = c.get("replica")
+            if idx is not None:
+                rep = entry.replicas.replicas[idx]
+                if getattr(rep, "_ckpt_lock", None) is not None:
+                    serve_faults.kill9_replica(registry, model, idx)
+                    killed_via = "kill9"
+                else:
+                    serve_faults.kill_replica(registry, model, idx)
+                    killed_via = "kill"
+            wait_for(lambda: outcome_for(30), 30.0)
+        finally:
+            promoter.min_shadow = hold
+        o3 = dict(outcome_for(30) or {})
+        o3["killed_via"] = killed_via
+        record["phases"]["canary_kill"] = o3
+        canary_ok = (o3.get("outcome") == "rolled_back"
+                     and o3.get("reason") == "canary_died")
+
+        # phase 4: drift-injected candidate auto-rolls back on the gauge
+        wait_for(lambda: healthy_replicas() >= 2, 30.0)
+        proc = spawn([{"step": 40, "ckpt": drifted, "val_loss": 0.1}])
+        proc.wait(timeout=120)
+        record["published"] += 1
+        wait_for(lambda: outcome_for(40), 40.0, poke=True)
+        o4 = dict(outcome_for(40) or {})
+        record["phases"]["drift"] = o4
+        drift_ok = (o4.get("outcome") == "rolled_back"
+                    and o4.get("reason") == "drift")
+
+        # phase-4's publisher swept phase-2's orphan on its way in
+        record["tmp_swept"] = not any(".tmp." in f
+                                      for f in os.listdir(watch))
+        rz = readyz()
+        record["readyz"] = rz.get("promote")
+        coherent = bool((rz.get("promote") or {}).get("fleet_coherent"))
+        record["status"] = promoter.status()
+        record["ok"] = bool(promote_ok and kill_ok and canary_ok
+                            and drift_ok and record["tmp_swept"]
+                            and coherent)
+        obs.event("chaos/promote_drill", ok=record["ok"],
+                  published=record["published"],
+                  phases={k: {kk: v.get(kk) for kk in ("outcome", "reason",
+                                                       "ok")}
+                          for k, v in record["phases"].items()})
+    except Exception as exc:
+        record["error"] = repr(exc)
+    finally:
+        for p in children:
+            if p.poll() is None:
+                try:
+                    p.kill()
+                except Exception:
+                    pass
 
 
 # ---- replay -----------------------------------------------------------------
@@ -744,6 +990,15 @@ def main(argv=None) -> int:
     ap.add_argument("--chaos", type=str, default=None,
                     help="serving fault schedule, e.g. 'kill@0.3:replica=0;"
                          "swap@1.0:ckpt=/p/b.ckpt' (in-process gateway only)")
+    ap.add_argument("--promote", action="store_true",
+                    help="run the continuous-promotion chaos drill alongside "
+                         "the replay: publisher child processes land good / "
+                         "drift candidates into the conveyor, the trainer is "
+                         "SIGKILLed mid-publish, and the canary replica is "
+                         "killed mid-promotion (in-process gateway only; "
+                         "forces >= 3 replicas unless --replicas is given)")
+    ap.add_argument("--publish-child", type=str, default=None,
+                    help=argparse.SUPPRESS)  # internal: the drill's trainer
     ap.add_argument("--profile", type=str, default=None,
                     choices=tuple(PROFILES),
                     help="phased load shape (steady|ramp|spike10x); "
@@ -765,6 +1020,8 @@ def main(argv=None) -> int:
     ap.add_argument("--obs-dir", type=str, default="logs/traffic_gen",
                     help="event sink dir (<dir>/obs/events.jsonl); '' off")
     args = ap.parse_args(argv)
+    if args.publish_child:
+        return publish_child_main(args.publish_child)
     args.size_list = [int(s) for s in args.sizes.split(",") if s.strip()]
     if not args.size_list:
         print("traffic_gen: --sizes is empty", file=sys.stderr)  # noqa: obs-print
@@ -777,6 +1034,11 @@ def main(argv=None) -> int:
     if chaos_events and args.url:
         print("traffic_gen: --chaos needs the in-process gateway (the "
               "injectors reach into the live registry); drop --url",
+              file=sys.stderr)  # noqa: obs-print
+        return 2
+    if args.promote and args.url:
+        print("traffic_gen: --promote needs the in-process gateway (the "
+              "drill reaches into the live promoter); drop --url",
               file=sys.stderr)  # noqa: obs-print
         return 2
     if args.autoscale:
@@ -800,6 +1062,34 @@ def main(argv=None) -> int:
     if args.obs_dir:
         obs.configure_from_config(cfg, args.obs_dir,
                                   tags={"run": "traffic_gen"})
+
+    if args.promote:
+        # drill-tuned conveyor knobs: tee every request, a small shadow
+        # quorum, and a fast scan so the whole lifecycle fits one replay
+        import tempfile
+
+        pm = cfg.promote
+        pm.enable = True
+        pm.publish = False
+        # always a FRESH conveyor dir: leftovers from a previous run would
+        # be scanned as live candidates by this run's promoter
+        root = args.obs_dir or None
+        if root:
+            os.makedirs(root, exist_ok=True)
+        pm.watch_dir = tempfile.mkdtemp(prefix="promote_watch_", dir=root)
+        pm.interval_s = 0.05
+        pm.shadow_sample = 1.0
+        pm.min_shadow = 3
+        pm.gate_timeout_s = 20.0
+        # CPU batch-shape compiles run seconds; a serving-tuned sub-second
+        # timeout would 504 the warm-cache misses and trip the SLO gate on
+        # compile noise rather than candidate quality
+        cfg.serve.request_timeout_ms = max(
+            float(cfg.serve.request_timeout_ms), 60_000.0)
+        if args.replicas is None:
+            # one replica to quarantine as the canary, two staying live so
+            # the canary-kill phase still leaves a real slice to pick next
+            args.replicas = max(3, int(cfg.serve.replicas))
 
     gw = server = registry = None
     if args.url:
@@ -836,10 +1126,22 @@ def main(argv=None) -> int:
                   models, feat_nf, edge_attr_nf, chaos_record),
             name="tg-chaos", daemon=True)
         chaos_thread.start()
+    promote_record = None
+    promote_thread = None
+    if args.promote:
+        promote_record = {}
+        promote_thread = threading.Thread(
+            target=run_promote_drill,
+            args=(args, gw, registry, models[0], base_url, feat_nf,
+                  edge_attr_nf, promote_record),
+            name="tg-promote", daemon=True)
+        promote_thread.start()
     results, wall = replay(base_url, plan, offsets, args.timeout_s,
                            max_retries=args.max_retries)
     if chaos_thread is not None:
         chaos_thread.join(timeout=args.timeout_s + 60.0)
+    if promote_thread is not None:
+        promote_thread.join(timeout=300.0)
     scale_state = None
     if gw is not None and gw.autoscaler.enable:
         # hold the gateway open while the calm-streak logic walks the fleet
@@ -886,6 +1188,7 @@ def main(argv=None) -> int:
         "lost": sum(1 for r in results if r["status"] < 0),
         "retries_total": sum(r.get("retries", 0) for r in results),
         "chaos": chaos_record or None,
+        "promote": promote_record,
         "profile": args.profile,
         "phases": phases,
         "autoscale": scale_state,

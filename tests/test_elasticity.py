@@ -82,6 +82,14 @@ class _FakeEntry:
         self.warmed = [SimpleNamespace(n=w, e=8 * w) for w in warmed]
         self.replica_factory = _FakeReplica
 
+    def add_replica(self, warm_sizes=None):
+        # mirrors ModelEntry.add_replica's surface (the swap-lock re-pin
+        # has no fake equivalent: there is no engine to version)
+        if self.replica_factory is None:
+            raise RuntimeError("no replica factory")
+        return self.replicas.add_replica(self.replica_factory,
+                                         warm_sizes=warm_sizes)
+
     def set_depth(self, depth):
         self.queue = SimpleNamespace(depth=lambda: depth)
 

@@ -1,0 +1,553 @@
+"""The promotion conveyor (distegnn_tpu/promote): publisher atomicity,
+drift gauge math, the promoter's canary/shadow/gate state machine with a
+synthetic clock, the trainer-side publish hook, the configs/*.yaml
+coverage lint, and the end-to-end ``traffic_gen --promote`` chaos drill
+(the PR's acceptance drill: two candidates under live traffic, a trainer
+kill mid-publish, a canary kill mid-promotion, an injected-drift
+rollback — zero lost requests and a coherent fleet version throughout).
+"""
+
+import json
+import os
+import subprocess
+import sys
+import time
+from types import SimpleNamespace
+
+import jax
+import numpy as np
+import pytest
+
+from distegnn_tpu.models.fast_egnn import FastEGNN
+from distegnn_tpu.obs.metrics import MetricsRegistry
+from distegnn_tpu.promote.drift import DriftGauge
+from distegnn_tpu.promote.promoter import (Promoter, fleet_coherent,
+                                           watch_dir_from_config)
+from distegnn_tpu.promote.publish import (CandidatePublisher,
+                                          candidate_manifest_name,
+                                          config_hash, list_candidates,
+                                          read_candidate)
+from distegnn_tpu.serve import InferenceEngine, RequestQueue
+from distegnn_tpu.serve.buckets import synthetic_graph
+from distegnn_tpu.serve.metrics import ServeMetrics
+from distegnn_tpu.serve.registry import ModelEntry
+from distegnn_tpu.train.checkpoint import save_checkpoint
+
+pytestmark = pytest.mark.serve
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(scope="module")
+def tiny():
+    from distegnn_tpu.ops.graph import pad_graphs
+
+    model = FastEGNN(node_feat_nf=1, edge_attr_nf=2, hidden_nf=16,
+                     virtual_channels=2, n_layers=2)
+    g = synthetic_graph(26, seed=5)
+    tight = pad_graphs([g], node_bucket=1, edge_bucket=1)
+    params = model.init(jax.random.PRNGKey(0), tight)
+    return SimpleNamespace(model=model, params=params, graph=g)
+
+
+def _save_params(path, params):
+    save_checkpoint(str(path),
+                    SimpleNamespace(params=params, opt_state={}, step=0),
+                    epoch=0)
+
+
+def _mk_entry(tiny, n=2, name="m"):
+    metrics = ServeMetrics()
+    kw = dict(batch_deadline_ms=2.0, request_timeout_ms=30_000.0)
+    engine = InferenceEngine(tiny.model, tiny.params, max_batch=2,
+                             metrics=metrics)
+    queue = RequestQueue(engine, metrics=metrics, **kw)
+    extra = []
+    for _ in range(n - 1):
+        e2 = InferenceEngine(tiny.model, tiny.params, max_batch=2,
+                             metrics=metrics)
+        extra.append((e2, RequestQueue(e2, metrics=metrics, **kw)))
+    return ModelEntry(name, engine, queue, feat_nf=1, edge_attr_nf=2,
+                      extra_replicas=extra,
+                      supervisor_opts=dict(heartbeat_s=3600.0))
+
+
+# ---- publisher: atomicity, retention, verification --------------------------
+
+def test_publish_writes_verified_candidate_with_no_tmp_residue(
+        tiny, tmp_path):
+    src = tmp_path / "src.ckpt"
+    _save_params(src, tiny.params)
+    watch = tmp_path / "conveyor"
+    pub = CandidatePublisher(str(watch), history=4)
+    mpath = pub.publish(str(src), step=12, val_loss=0.25,
+                        config={"model": {"hidden_nf": 16}})
+    assert os.path.basename(mpath) == candidate_manifest_name(12)
+    assert list_candidates(str(watch)) == [12]
+    assert not any(".tmp." in f for f in os.listdir(watch))
+    man = read_candidate(str(watch), 12)
+    assert man["step"] == 12 and man["val_loss"] == 0.25
+    assert man["config_hash"] == config_hash({"model": {"hidden_nf": 16}})
+    assert man["size"] == os.path.getsize(src)
+    assert os.path.getsize(man["ckpt_path"]) == man["size"]
+
+
+def test_publish_prunes_beyond_history_manifest_first(tiny, tmp_path):
+    src = tmp_path / "src.ckpt"
+    _save_params(src, tiny.params)
+    watch = tmp_path / "conveyor"
+    pub = CandidatePublisher(str(watch), history=2)
+    for step in (1, 2, 3, 4):
+        pub.publish(str(src), step=step)
+    assert list_candidates(str(watch)) == [3, 4]
+    # withdrawn candidates lose BOTH files, not just the manifest
+    assert sorted(os.listdir(watch)) == [
+        "step_0000000003.ckpt", candidate_manifest_name(3),
+        "step_0000000004.ckpt", candidate_manifest_name(4)]
+
+
+def test_publish_sweeps_orphan_tmp_from_a_killed_publisher(tiny, tmp_path):
+    src = tmp_path / "src.ckpt"
+    _save_params(src, tiny.params)
+    watch = tmp_path / "conveyor"
+    os.makedirs(watch)
+    orphan = watch / "step_0000000007.ckpt.tmp.abc123"
+    orphan.write_bytes(b"torn")
+    CandidatePublisher(str(watch)).publish(str(src), step=8)
+    assert not orphan.exists()
+    assert list_candidates(str(watch)) == [8]
+
+
+def test_read_candidate_rejects_torn_and_missing(tiny, tmp_path):
+    src = tmp_path / "src.ckpt"
+    _save_params(src, tiny.params)
+    watch = tmp_path / "conveyor"
+    pub = CandidatePublisher(str(watch))
+    pub.publish(str(src), step=5)
+    ckpt = watch / "step_0000000005.ckpt"
+    blob = ckpt.read_bytes()
+
+    ckpt.write_bytes(blob[:-16])                       # truncated
+    with pytest.raises(ValueError, match="size mismatch"):
+        read_candidate(str(watch), 5)
+    ckpt.write_bytes(blob[:-1] + bytes([blob[-1] ^ 0xFF]))  # bit-rot
+    with pytest.raises(ValueError, match="crc32 mismatch"):
+        read_candidate(str(watch), 5)
+    ckpt.unlink()                                      # withdrawn bytes
+    with pytest.raises(ValueError, match="missing checkpoint"):
+        read_candidate(str(watch), 5)
+    (watch / candidate_manifest_name(5)).write_text("{not json")
+    with pytest.raises(ValueError, match="unreadable manifest"):
+        read_candidate(str(watch), 5)
+    with pytest.raises(ValueError, match="unreadable manifest"):
+        read_candidate(str(watch), 99)                 # never published
+
+
+def test_config_hash_is_order_stable():
+    assert config_hash({"a": 1, "b": 2}) == config_hash({"b": 2, "a": 1})
+    assert config_hash({"a": 1}) != config_hash({"a": 2})
+    assert config_hash(None) is None
+
+
+# ---- drift gauge ------------------------------------------------------------
+
+def test_drift_gauge_relative_l2_and_ceiling_verdict():
+    g = DriftGauge(ceiling=0.05, min_samples=2)
+    live = np.ones((8, 3))
+    d = g.observe("n26", live, live * 1.01)
+    assert d == pytest.approx(0.01, rel=1e-6)
+    assert not g.drifted() and not g.decided()
+    g.observe("n26", live, live * 1.02)
+    assert g.samples == 2 and g.decided() and not g.drifted()
+    snap = g.snapshot()["n26"]
+    assert snap["count"] == 2 and snap["nonfinite"] == 0
+    assert snap["mean"] == pytest.approx(0.015, abs=1e-6)
+    assert snap["max"] == pytest.approx(0.02, abs=1e-6)
+    # a third sample shifts the rung mean over the ceiling
+    g.observe("n26", live, live * 1.2)
+    assert g.drifted()
+
+
+def test_drift_gauge_nonfinite_or_shape_mismatch_drifts():
+    g = DriftGauge(ceiling=10.0, min_samples=100)
+    live = np.ones((4, 3))
+    bad = live.copy()
+    bad[0, 0] = np.nan
+    assert g.observe("n26", live, bad) == float("inf")
+    assert g.drifted() and g.decided()   # no point waiting to reject
+
+    g2 = DriftGauge(ceiling=10.0)
+    g2.observe("n26", live, np.ones((5, 3)))
+    assert g2.drifted()
+
+
+def test_drift_gauge_exports_per_rung_gauges():
+    g = DriftGauge(ceiling=0.05)
+    g.observe("n26", np.ones((4, 3)), np.ones((4, 3)) * 1.01)
+    reg = MetricsRegistry()
+    g.export(reg)
+    assert reg.gauge("promote/drift_n26_mean").value == pytest.approx(
+        0.01, abs=1e-5)
+    assert reg.gauge("promote/drift_n26_max").value == pytest.approx(
+        0.01, abs=1e-5)
+
+
+# ---- promoter state machine (synthetic clock, real replicas) ----------------
+
+def _mk_promoter(entry, watch, monitor=None, **over):
+    reg = SimpleNamespace(names=lambda: [entry.name],
+                          get=lambda n: {entry.name: entry}[n])
+    knobs = dict(enable=True, watch_dir=str(watch), interval_s=3600.0,
+                 shadow_sample=1.0, min_shadow=2, gate_timeout_s=10.0,
+                 drift_ceiling=0.05, max_error_rate=0.0)
+    knobs.update(over)
+    return Promoter(reg, monitor, config=knobs)
+
+
+def _publish(tiny, tmp_path, watch, step, scale=1.0001, name="cand.ckpt"):
+    params = jax.tree.map(lambda x: x * scale, tiny.params)
+    src = tmp_path / name
+    _save_params(src, params)
+    CandidatePublisher(str(watch)).publish(str(src), step=step)
+
+
+def _feed_shadows(pm, entry, tiny, n, live_scale=1.0):
+    """Tee n live predicts (optionally with distorted live outputs, to
+    force a drift verdict deterministically) and wait for the shadow
+    futures to land in the gauge."""
+    run = pm._canary
+    for i in range(n):
+        g = dict(tiny.graph)
+        out = entry.queue.submit(dict(g)).result(timeout=60.0)
+        pm.tee(entry.name, g, None, f"r{i}", np.asarray(out) * live_scale)
+    deadline = time.monotonic() + 30.0
+    while (run.gauge.samples + run.shadow_errors < n
+           and time.monotonic() < deadline):
+        time.sleep(0.02)
+
+
+def test_promoter_promotes_through_canary_and_shadow(tiny, tmp_path):
+    entry = _mk_entry(tiny, n=2)
+    entry.start()
+    entry.warmup([26])
+    pm = _mk_promoter(entry, tmp_path / "conveyor")
+    try:
+        _publish(tiny, tmp_path, tmp_path / "conveyor", step=5)
+        pm.tick(now=0.0)
+        st = pm.status()
+        assert st["state"] == "canary" and st["canary"]["step"] == 5
+        # the canary slice is OUT of live rotation while shadowing
+        cidx = st["canary"]["replica"]
+        assert entry.replicas.quarantined() == {cidx}
+        assert st["fleet_coherent"] is False   # mid-canary: undecided
+
+        _feed_shadows(pm, entry, tiny, 2)
+        pm.tick(now=1.0)
+        assert pm.promoted == 1 and entry.params_version == 1
+        assert pm.results[-1]["outcome"] == "promoted"
+        assert pm.results[-1]["shadow"]["teed"] == 2
+        assert not entry.replicas.quarantined()
+        assert fleet_coherent(entry)
+        st = pm.status()
+        assert st["state"] == "idle" and st["fleet_step"] == 5
+        assert st["fleet_coherent"] is True and st["last_step"] == 5
+    finally:
+        pm.stop()
+        entry.stop()
+
+
+def test_promoter_rolls_back_on_drift(tiny, tmp_path):
+    entry = _mk_entry(tiny, n=2)
+    entry.start()
+    entry.warmup([26])
+    pm = _mk_promoter(entry, tmp_path / "conveyor")
+    old = entry.engine.params
+    try:
+        _publish(tiny, tmp_path, tmp_path / "conveyor", step=5)
+        pm.tick(now=0.0)
+        cidx = pm.status()["canary"]["replica"]
+        # distorted "live" outputs: canary-vs-live divergence ~1, far over
+        # the 0.05 ceiling, without depending on candidate param deltas
+        _feed_shadows(pm, entry, tiny, 2, live_scale=2.0)
+        pm.tick(now=1.0)
+        assert pm.rolled_back == 1 and entry.params_version == 0
+        assert pm.results[-1]["outcome"] == "rolled_back"
+        assert pm.results[-1]["reason"] == "drift"
+        # the canary replica is re-pinned to the live version and released
+        assert entry.replicas.replicas[cidx].engine.params is old
+        assert not entry.replicas.quarantined()
+        assert fleet_coherent(entry)
+    finally:
+        pm.stop()
+        entry.stop()
+
+
+def test_promoter_rolls_back_when_canary_dies(tiny, tmp_path):
+    entry = _mk_entry(tiny, n=2)
+    entry.start()
+    entry.warmup([26])
+    pm = _mk_promoter(entry, tmp_path / "conveyor")
+    try:
+        _publish(tiny, tmp_path, tmp_path / "conveyor", step=5)
+        pm.tick(now=0.0)
+        run = pm._canary
+        assert run is not None
+        run.replica.healthy = lambda: False   # SIGKILL's observable effect
+        pm.tick(now=0.5)
+        assert pm.results[-1] == {"step": 5, "outcome": "rolled_back",
+                                  "reason": "canary_died",
+                                  "shadow": pm.results[-1]["shadow"]}
+        assert not entry.replicas.quarantined()
+        assert entry.params_version == 0
+    finally:
+        pm.stop()
+        entry.stop()
+
+
+def test_promoter_rolls_back_on_insufficient_shadow(tiny, tmp_path):
+    entry = _mk_entry(tiny, n=2)
+    entry.start()
+    entry.warmup([26])
+    pm = _mk_promoter(entry, tmp_path / "conveyor", gate_timeout_s=5.0)
+    try:
+        _publish(tiny, tmp_path, tmp_path / "conveyor", step=5)
+        pm.tick(now=0.0)
+        pm.tick(now=4.9)     # inside the gate window: still canarying
+        assert pm.status()["state"] == "canary"
+        pm.tick(now=5.1)     # timed out with ZERO shadow evidence
+        assert pm.results[-1]["outcome"] == "rolled_back"
+        assert pm.results[-1]["reason"] == "insufficient_shadow"
+    finally:
+        pm.stop()
+        entry.stop()
+
+
+def test_promoter_slo_gate_blocks_promotion(tiny, tmp_path):
+    entry = _mk_entry(tiny, n=2)
+    entry.start()
+    entry.warmup([26])
+    monitor = SimpleNamespace(
+        window_snapshot=lambda now=None: {"error_rate": 0.5})
+    pm = _mk_promoter(entry, tmp_path / "conveyor", monitor=monitor)
+    try:
+        _publish(tiny, tmp_path, tmp_path / "conveyor", step=5)
+        pm.tick(now=0.0)
+        _feed_shadows(pm, entry, tiny, 2)
+        pm.tick(now=1.0)
+        assert pm.results[-1]["outcome"] == "rolled_back"
+        assert pm.results[-1]["reason"] == "slo"
+        assert entry.params_version == 0 and fleet_coherent(entry)
+    finally:
+        pm.stop()
+        entry.stop()
+
+
+def test_promoter_rejects_torn_candidate_without_canarying(tiny, tmp_path):
+    entry = _mk_entry(tiny, n=2)
+    entry.start()
+    entry.warmup([26])
+    watch = tmp_path / "conveyor"
+    pm = _mk_promoter(entry, watch)
+    try:
+        _publish(tiny, tmp_path, watch, step=5)
+        ckpt = watch / "step_0000000005.ckpt"
+        ckpt.write_bytes(ckpt.read_bytes()[:-8])
+        pm.tick(now=0.0)
+        assert pm.rejected == 1
+        assert pm.results[-1]["outcome"] == "rejected"
+        assert pm.results[-1]["reason"].startswith("verify:")
+        # spent, never retried: the conveyor position moved past it
+        assert pm.last_step == 5 and pm.status()["state"] == "idle"
+        assert not entry.replicas.quarantined()
+    finally:
+        pm.stop()
+        entry.stop()
+
+
+def test_promoter_newest_candidate_wins(tiny, tmp_path, monkeypatch):
+    entry = _mk_entry(tiny, n=2)
+    entry.start()
+    entry.warmup([26])
+    watch = tmp_path / "conveyor"
+    pm = _mk_promoter(entry, watch)
+    events = []
+    import distegnn_tpu.promote.promoter as pmod
+    monkeypatch.setattr(pmod.obs, "event",
+                        lambda name, **kw: events.append((name, kw)))
+    try:
+        _publish(tiny, tmp_path, watch, step=5, name="a.ckpt")
+        _publish(tiny, tmp_path, watch, step=7, name="b.ckpt")
+        pm.tick(now=0.0)
+        assert pm.status()["canary"]["step"] == 7
+        skips = [kw for n, kw in events if n == "promote/candidates_skipped"]
+        assert skips and skips[0]["skipped"] == [5] and skips[0]["chosen"] == 7
+    finally:
+        pm.stop()
+        entry.stop()
+
+
+def test_promoter_single_replica_falls_through_to_direct_swap(
+        tiny, tmp_path):
+    entry = _mk_entry(tiny, n=1)
+    entry.start()
+    entry.warmup([26])
+    pm = _mk_promoter(entry, tmp_path / "conveyor")
+    try:
+        _publish(tiny, tmp_path, tmp_path / "conveyor", step=5)
+        pm.tick(now=0.0)
+        # no slice to spare: the plain blue/green swap promoted directly
+        assert pm.promoted == 1 and entry.params_version == 1
+        assert pm.results[-1]["outcome"] == "promoted"
+        assert pm.results[-1].get("direct") is True
+        assert fleet_coherent(entry)
+    finally:
+        pm.stop()
+        entry.stop()
+
+
+def test_watch_dir_from_config():
+    assert watch_dir_from_config({"promote": {"watch_dir": "/c"}}) == "/c"
+    assert watch_dir_from_config({}) == ""
+    assert watch_dir_from_config(SimpleNamespace()) == ""
+
+
+# ---- trainer end: publish-on-rotation hook ----------------------------------
+
+def test_cadence_saver_publishes_rotated_checkpoint(tiny, tmp_path):
+    from distegnn_tpu.train.trainer import CadenceSaver
+
+    watch = tmp_path / "conveyor"
+    pub = CandidatePublisher(str(watch))
+    saver = CadenceSaver(str(tmp_path / "ckpts"), interval_s=1e-9, keep=3,
+                         config={"seed": 1}, seed=1, enabled=True,
+                         publisher=pub)
+    saver.last_val_loss = 0.125
+    saver._last = float("-inf")
+    state = SimpleNamespace(params=tiny.params, opt_state={}, step=42)
+    saver.maybe_save(state, completed_epoch=0, step_in_epoch=3)
+    assert saver.saves == 1
+    assert list_candidates(str(watch)) == [42]
+    man = read_candidate(str(watch), 42)
+    assert man["val_loss"] == 0.125
+    assert man["config_hash"] == config_hash({"seed": 1})
+
+
+def test_cadence_saver_survives_publish_failure(tiny, tmp_path):
+    from distegnn_tpu.train.trainer import CadenceSaver
+
+    class _Exploding:
+        def publish(self, *a, **kw):
+            raise OSError("conveyor full")
+
+    saver = CadenceSaver(str(tmp_path / "ckpts"), interval_s=1e-9, keep=3,
+                         config=None, seed=1, enabled=True,
+                         publisher=_Exploding())
+    saver._last = float("-inf")
+    state = SimpleNamespace(params=tiny.params, opt_state={}, step=7)
+    saver.maybe_save(state, completed_epoch=0, step_in_epoch=0)  # no raise
+    assert saver.saves == 1   # the checkpoint itself landed
+
+
+def test_rotation_emits_obs_event(tiny, tmp_path, monkeypatch):
+    import distegnn_tpu.train.checkpoint as ckpt_mod
+
+    for step in (1, 2, 3):
+        _save_params(tmp_path / f"step_{step:010d}.ckpt", tiny.params)
+    events = []
+    monkeypatch.setattr(ckpt_mod.obs, "event",
+                        lambda name, **kw: events.append((name, kw)))
+    removed = ckpt_mod.rotate_checkpoints(str(tmp_path), keep=1)
+    assert len(removed) == 2
+    rot = [kw for n, kw in events if n == "ckpt/rotate"]
+    assert rot == [{"step": 3, "bytes": os.path.getsize(
+        tmp_path / "step_0000000003.ckpt"), "kept": 1, "removed": 2}]
+
+
+# ---- config lint: yaml section coverage -------------------------------------
+
+def _find_violations():
+    sys.path.insert(0, os.path.join(REPO, "scripts"))
+    try:
+        from check_config_keys import find_violations
+    finally:
+        sys.path.pop(0)
+    return find_violations
+
+
+def test_yaml_lint_flags_unregistered_top_level_section(tmp_path):
+    (tmp_path / "x.yaml").write_text(
+        "seed: 1\npromote:\n  enable: true\nbogus_section:\n  a: 1\n")
+    out = _find_violations()(autoscale_path=None, promoter_path=None,
+                             configs_dir=str(tmp_path))
+    msgs = [msg for _, _, msg in out]
+    assert any("bogus_section" in m and "_DEFAULTS" in m for m in msgs)
+    assert not any("'promote:'" in m for m in msgs)
+
+
+def test_yaml_lint_accepts_all_shipped_configs():
+    out = _find_violations()(autoscale_path=None, promoter_path=None)
+    assert [msg for _, _, msg in out if "top-level section" in msg] == []
+
+
+# ---- the acceptance drill ---------------------------------------------------
+
+def _run_promote_drill(tmp_path, extra=()):
+    obs_dir = tmp_path / "tg"
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO, "scripts", "traffic_gen.py"),
+         "--config_path", os.path.join(REPO, "configs",
+                                       "nbody_promote.yaml"),
+         "--promote", "--requests", "80", "--rate", "20",
+         "--mix", "predict=0.8,session=0.2", "--sizes", "24,48",
+         "--seed", "7", "--obs-dir", str(obs_dir), *extra],
+        capture_output=True, text=True, cwd=REPO, timeout=420,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"})
+    assert r.returncode == 0, r.stderr
+    lines = [ln for ln in r.stdout.strip().splitlines() if ln]
+    assert len(lines) == 1, r.stdout
+    return json.loads(lines[0])
+
+
+def _assert_drill(rec):
+    pr = rec["promote"]
+    assert pr.get("error") is None, pr
+    ph = pr["phases"]
+    # phase 1: a published candidate promoted through canary + shadow
+    assert ph["promote"]["outcome"] == "promoted"
+    # phase 2: trainer SIGKILLed mid-publish — orphan tmp only, the
+    # conveyor never saw a half-candidate
+    assert ph["trainer_kill"]["ok"] is True
+    assert ph["trainer_kill"]["orphan_tmp"] is True
+    assert ph["trainer_kill"]["manifest_appeared"] is False
+    # phase 3: canary killed mid-promotion — immediate rollback
+    assert ph["canary_kill"]["outcome"] == "rolled_back"
+    assert ph["canary_kill"]["reason"] == "canary_died"
+    # phase 4: injected drift rolled back on the gauge
+    assert ph["drift"]["outcome"] == "rolled_back"
+    assert ph["drift"]["reason"] == "drift"
+    assert pr["tmp_swept"] is True
+    assert pr["readyz"]["fleet_coherent"] is True
+    assert pr["ok"] is True
+    # zero lost requests across every injection
+    assert rec["lost"] == 0 and rec["errors"] == 0
+    assert rec["completed"] == rec["requests"]
+
+
+def test_promotion_conveyor_drill_thread_backend(tmp_path):
+    """The PR's acceptance drill from ONE ``traffic_gen --promote`` run:
+    candidates published under live traffic promote through canary +
+    shadow, a trainer kill mid-publish leaves only a swept tmp orphan, a
+    canary kill mid-promotion and an injected-drift candidate both roll
+    back automatically, with zero lost requests and a coherent fleet
+    version on /readyz at the end."""
+    _assert_drill(_run_promote_drill(tmp_path))
+
+
+@pytest.mark.slow
+@pytest.mark.process
+def test_promotion_conveyor_drill_process_workers(tmp_path):
+    """Same drill with process-isolated workers: the canary kill is a real
+    SIGKILL of the worker child."""
+    rec = _run_promote_drill(tmp_path, extra=("--workers", "process"))
+    _assert_drill(rec)
+    assert rec["promote"]["phases"]["canary_kill"]["killed_via"] == "kill9"

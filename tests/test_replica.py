@@ -347,6 +347,82 @@ def test_swap_one_at_a_time(tiny, tmp_path):
         entry.stop()
 
 
+def test_scale_up_racing_swap_lands_on_live_version(tmp_path):
+    """Registry claim (``ModelEntry.replica_factory`` docstring): a replica
+    added while a blue/green swap is in flight comes up on the LIVE
+    version. The factory snapshots the entry's params at build time, so a
+    build that reads the pre-swap snapshot and appends after the flip loop
+    finished would otherwise serve the retired version forever — the worst
+    interleaving, forced deterministically here by gating the factory
+    until the swap has fully landed."""
+    from distegnn_tpu.config import ConfigDict, _DEFAULTS
+    from distegnn_tpu.serve.autoscale import ReplicaAutoscaler
+    from distegnn_tpu.serve.registry import ModelRegistry
+
+    cfg = ConfigDict(_DEFAULTS)
+    cfg.serve.replicas = 1
+    registry = ModelRegistry.from_config(cfg)
+    entry = registry.get("default")
+    registry.start()
+    try:
+        entry.warmup([26])
+        assert entry.replica_factory is not None
+
+        orig = entry.replica_factory
+        built = threading.Event()
+        release = threading.Event()
+
+        def gated(idx):
+            rep = orig(idx)          # snapshots entry.engine.params NOW
+            built.set()
+            assert release.wait(60.0)
+            return rep
+
+        entry.replica_factory = gated
+        auto = ReplicaAutoscaler(registry, config=dict(enable=True))
+        grow_err = []
+
+        def grow():
+            try:
+                auto._grow("default", entry, 1)
+            except Exception as exc:
+                grow_err.append(exc)
+
+        t = threading.Thread(target=grow, daemon=True)
+        t.start()
+        assert built.wait(60.0), "replica factory never ran"
+
+        # the swap runs to completion while the stale-built replica is
+        # still unappended: its flip loop sees ONE replica
+        params_b = jax.tree.map(lambda x: x * 1.0625, entry.engine.params)
+        ck = tmp_path / "b.ckpt"
+        _save_params(ck, params_b)
+        info = entry.swap(str(ck))
+        assert info["replicas"] == 1 and entry.params_version == 1
+
+        release.set()
+        t.join(timeout=120.0)
+        assert not t.is_alive() and not grow_err, grow_err
+
+        reps = entry.replicas.replicas
+        assert len(reps) == 2
+        # the late joiner was re-pinned to the live version, not left on
+        # the snapshot it was built from
+        for r in reps:
+            assert r.engine.params is entry.engine.params
+        # and both replicas actually serve it: round-robin pair agrees
+        g = synthetic_graph(26, seed=5,
+                            feat_nf=int(cfg.model.node_feat_nf),
+                            edge_attr_nf=int(cfg.model.edge_attr_nf))
+        futs = [entry.queue.submit(dict(g)) for _ in range(2)]
+        outs = [f.result(timeout=120.0) for f in futs]
+        assert {f.meta["replica"] for f in futs} == {0, 1}
+        np.testing.assert_array_equal(np.asarray(outs[0]),
+                                      np.asarray(outs[1]))
+    finally:
+        registry.stop(drain=False)
+
+
 # ---- per-model shed isolation over a live socket ----------------------------
 
 def test_gateway_sheds_only_the_dead_model(tiny):

@@ -184,11 +184,21 @@ def test_sigterm_on_one_host_stops_all_at_same_step():
 
 def test_resume_consensus_mismatch_fails_loudly():
     """Half-propagated checkpoint dir: hosts resolve different resume points;
-    the barrier must raise BEFORE any step runs, naming the divergent views."""
+    the barrier must raise a TYPED error BEFORE any step runs, naming the
+    lagging host and the local checkpoint path to diff against."""
+    from distegnn_tpu.train.checkpoint import ResumeConsensusError
+
     views = np.asarray([[3, 17], [3, 17], [3, 12], [3, 17]], np.int64)
-    with pytest.raises(RuntimeError, match="consensus") as ei:
-        verify_resume_consensus(3, 17, allgather=lambda x: views)
-    assert "step_in_epoch=12" in str(ei.value)
+    with pytest.raises(ResumeConsensusError, match="consensus") as ei:
+        verify_resume_consensus(3, 17, allgather=lambda x: views,
+                                path="/ckpt/state_dict/step_0000000017.ckpt")
+    err = ei.value
+    assert err.lagging == [2], "process 2 holds the stale view"
+    assert err.coords == [(3, 17), (3, 17), (3, 12), (3, 17)]
+    assert err.local_path.endswith("step_0000000017.ckpt")
+    msg = str(err)
+    assert "process 2" in msg and "step_in_epoch=12" in msg
+    assert "step_0000000017.ckpt" in msg
 
 
 def test_resume_consensus_single_process_noop():

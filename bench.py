@@ -161,6 +161,12 @@ RACE_ORDER = (
     # single-chip tunnel it fail-records in seconds and the race moves on;
     # on CPU (test_bench_unlosable.py) bench provisions virtual devices.
     (["--mesh", "1x1x2"], None),
+    # Tiled-serving leg (serve/tiled.py): inference nodes/sec through the
+    # giant-scene tile executor — tile count, halo fraction and the
+    # H2D-overlap stall fraction on this session's hardware. Its metric is
+    # tiled_serve_nodes_per_sec (an INFERENCE number), which never contends
+    # for the race's training headline.
+    (["--layout", "tiled"], None),
     # Input-pipeline leg LAST (host-side graphs/s + stall fractions for the
     # streamed-shard prefetch A/B, data/stream.py): its metric is
     # io_pipeline_graphs_per_sec, which never contends for the race's
@@ -582,6 +588,87 @@ def measure_io():
     }
 
 
+def measure_tiled():
+    """Tiled-serving leg: inference nodes/sec for ONE giant scene through
+    the fixed-shape tile executor (serve/tiled.py) — the million-node
+    serving path's throughput plus its three health gauges (tile count,
+    halo fraction, H2D-overlap stall fraction). An INFERENCE number, never
+    the training headline. Self-caps via BENCH_TILED_NODES; tile size via
+    BENCH_TILE_NODES (default N/6 so the leg always actually tiles);
+    BENCH_TILED_IMPL=fused runs the halo-aware fused edge pipeline."""
+    import jax
+
+    from distegnn_tpu.models.fast_egnn import FastEGNN
+    from distegnn_tpu.ops.graph import pad_graphs
+    from distegnn_tpu.serve.engine import InferenceEngine
+    from distegnn_tpu.serve.tiled import TiledExecutor
+
+    global N_NODES
+    cap = _env_int("BENCH_TILED_NODES", N_NODES)
+    if N_NODES > cap:
+        print(f"bench: tiled leg capped at N={cap}", file=sys.stderr)
+        N_NODES = cap
+    impl = os.environ.get("BENCH_TILED_IMPL", "plain")
+    if impl not in ("plain", "fused"):
+        impl = "plain"
+    tile_nodes = _env_int("BENCH_TILE_NODES", 0)
+    if tile_nodes <= 0:
+        tile_nodes = max(512, (N_NODES // 6 // 512) * 512)
+    steps = max(1, _env_int("BENCH_TILED_STEPS", 2))
+
+    cloud, n_edges = make_fluid_cloud(np.random.default_rng(0))
+    model = FastEGNN(node_feat_nf=3, node_attr_nf=2, edge_attr_nf=2,
+                     hidden_nf=HIDDEN, virtual_channels=CHANNELS,
+                     n_layers=LAYERS, edge_impl=impl)
+    # params from a tiny same-featured batch (shapes are size-independent)
+    small = {k: (v[:64] if k in ("node_feat", "node_attr", "loc", "vel",
+                                 "target") else v) for k, v in cloud.items()}
+    ei = cloud["edge_index"]
+    sel = (ei[0] < 64) & (ei[1] < 64)
+    small["edge_index"] = (ei[:, sel] if sel.any()
+                           else np.array([[0, 1], [1, 0]], np.int32))
+    small["edge_attr"] = (cloud["edge_attr"][sel] if sel.any()
+                          else cloud["edge_attr"][:2])
+    if impl == "fused":
+        init_batch = pad_graphs([small], max_nodes=1536, edge_block=512,
+                                edge_tile=512, split_remote=True,
+                                compute_pair=False)
+        layout = {"edge_block": 512, "split_remote": True}
+    else:
+        init_batch = pad_graphs([small], node_bucket=1, edge_bucket=1)
+        layout = None
+    params = model.init(jax.random.PRNGKey(0), init_batch)
+    engine = InferenceEngine(model, params, layout_opts=layout)
+    tx = TiledExecutor(engine, {"tile_nodes": tile_nodes,
+                                "max_nodes": max(N_NODES, 4_194_304)})
+
+    out = tx.predict(dict(cloud))            # warmup: compiles + first pass
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        out = tx.predict(dict(cloud))
+    dt = time.perf_counter() - t0
+
+    nodes_per_sec = N_NODES * steps / dt
+    platform = jax.devices()[0].platform
+    return {
+        "metric": "tiled_serve_nodes_per_sec",
+        "value": round(nodes_per_sec, 1),
+        "unit": (f"inference nodes/sec through the tiled executor "
+                 f"(N={N_NODES}, E={n_edges}, tiles={out['tiles']} x "
+                 f"{tile_nodes} own nodes (padded {out['padded_nodes']}), "
+                 f"impl={impl}, layers={LAYERS}, platform={platform}; "
+                 f"serving leg, not a training headline)"),
+        "vs_baseline": None,
+        "tiles": out["tiles"],
+        "tile_nodes": tile_nodes,
+        "padded_nodes": out["padded_nodes"],
+        "halo_fraction": round(out["halo_fraction"], 4),
+        "h2d_stall_fraction": round(out["stall_fraction"], 4),
+        "work_imbalance": round(out["work_imbalance"], 4),
+        "pass_ms": round(dt / steps * 1e3, 1),
+    }
+
+
 def main():
     # BENCH_PLATFORM=cpu pins the backend for smoke tests — NOTE env var
     # JAX_PLATFORMS alone is not enough on axon-tunnel hosts (the tunnel
@@ -604,7 +691,8 @@ def main():
 
     args = sys.argv[1:]
     layout, impl, seg, fuse, mesh_str = "auto", "einsum", "scatter", True, None
-    usage = ("usage: bench.py [--layout plain|blocked|fused|fused_stack|io|auto] "
+    usage = ("usage: bench.py [--layout plain|blocked|fused|fused_stack|"
+             "tiled|io|auto] "
              "[--impl pallas|einsum] [--seg scatter|cumsum|ell] "
              "[--fuse 0|1] [--mesh DxGxT]  "
              "(env: BENCH_REORDER, BENCH_AGG_DTYPE, BENCH_STACK_NODES, "
@@ -618,8 +706,8 @@ def main():
     if "--layout" in args:
         i = args.index("--layout")
         if i + 1 >= len(args) or args[i + 1] not in ("plain", "blocked", "fused",
-                                                     "fused_stack", "io",
-                                                     "auto", "probe"):
+                                                     "fused_stack", "tiled",
+                                                     "io", "auto", "probe"):
             sys.exit(usage)
         layout = args[i + 1]
     if "--impl" in args:
@@ -693,6 +781,11 @@ def main():
             N_NODES = cap
         fb = _env_int("BENCH_FUSED_BLOCK", 512)
         _emit_bench(measure(fb, impl, seg, fuse, edge_impl="fused_stack"))
+        return
+    if layout == "tiled":
+        # giant-scene serving leg (tile executor nodes/sec + halo/stall
+        # gauges); an inference number, never the training headline
+        _emit_bench(measure_tiled())
         return
     if layout == "io":
         # input-pipeline A/B (prefetch vs blocking put over streamed shards);

@@ -208,6 +208,31 @@ _DEFAULTS: dict = {
         # skips Morton relabel + blocked re-pack + remote classify for
         # repeat-topology requests (prep_ms ~ gather-only).
         "session_cache": 64,
+        # byte bound for the same cache (plan nbytes accounting, evict-to-
+        # fit): tile plans for million-node scenes are MBs each, so the
+        # entry-count bound alone could pin GBs. 0 = unbounded by bytes.
+        "session_cache_bytes": 1 << 30,
+        # tiled giant-scene executor (serve/tiled.py): requests above
+        # serve.max_nodes serve through a scan over fixed-shape tiles with
+        # host-side halo exchange instead of 413-rejecting. Defaults match
+        # serve/tiled.py TILED_DEFAULTS (keep in sync); enable: false keeps
+        # the hard 413 behavior.
+        "tiled": {
+            "enable": False,
+            # admission bound for the tiled path itself (TiledOverflowError
+            # beyond it — still a 413, naming this knob)
+            "max_nodes": 4_194_304,
+            # own-node slots per tile; tile rung axes (halo, edges) are
+            # geometric above their floors so every giant scene lands on a
+            # small set of compiled tile shapes
+            "tile_nodes": 65536,
+            "halo_floor": 1024,
+            "edge_floor": 8192,
+            "growth": 2.0,
+            # tiled requests run L x n_tiles invocations: their queue/result
+            # deadlines stretch by this factor over request_timeout_ms
+            "timeout_factor": 8.0,
+        },
         # shared-nothing engine replicas per model (serve/replica.py): each
         # replica owns its own engine + dispatcher queue behind one
         # round-robin ReplicaSet; >= 2 enables failover of in-flight
@@ -286,6 +311,9 @@ _DEFAULTS: dict = {
             "degrade_p99_ms": None,
             # Retry-After multiplier for deferred/shed bulk requests
             "bulk_retry_factor": 4.0,
+            # predicts whose body is >= this many bytes default to the bulk
+            # class (tiled giant scenes); 0 disables the size heuristic
+            "bulk_content_bytes": 4_194_304,
         },
         # chunked streaming rollouts (POST .../rollout?stream=1): the steps
         # axis executes as successive chunk_steps-length compiled scans with
@@ -638,6 +666,33 @@ def validate_config(cfg: ConfigDict) -> None:
         raise ValueError("serve.result_margin_s must be > 0")
     if int(s.get("session_cache", 0)) < 0:
         raise ValueError("serve.session_cache must be >= 0 (0 disables)")
+    if int(s.get("session_cache_bytes", 0) or 0) < 0:
+        raise ValueError("serve.session_cache_bytes must be >= 0 "
+                         "(0 = unbounded by bytes)")
+    t = s.get("tiled")
+    if t is not None:
+        if not isinstance(t, Mapping):
+            raise ValueError("serve.tiled must be null or a mapping of "
+                             "tiled-executor knobs")
+        tknown = ("enable", "max_nodes", "tile_nodes", "halo_floor",
+                  "edge_floor", "growth", "timeout_factor")
+        for key in t:
+            if key not in tknown:
+                raise ValueError(f"serve.tiled: unknown key {key!r} "
+                                 f"(accepted: {', '.join(tknown)})")
+        if not isinstance(t.get("enable", False), bool):
+            raise ValueError("serve.tiled.enable must be a boolean")
+        for key in ("max_nodes", "tile_nodes", "halo_floor", "edge_floor"):
+            if int(t.get(key, 1)) < 1:
+                raise ValueError(f"serve.tiled.{key} must be >= 1")
+        if int(t.get("tile_nodes", 65536)) > int(t.get("max_nodes",
+                                                       4_194_304)):
+            raise ValueError("serve.tiled.tile_nodes must be <= "
+                             "serve.tiled.max_nodes")
+        if float(t.get("growth", 2.0)) <= 1.0:
+            raise ValueError("serve.tiled.growth must be > 1")
+        if float(t.get("timeout_factor", 8.0)) < 1.0:
+            raise ValueError("serve.tiled.timeout_factor must be >= 1")
     r = s.get("rollout")
     if r is not None:
         if not isinstance(r, Mapping):
@@ -728,7 +783,8 @@ def validate_config(cfg: ConfigDict) -> None:
             raise ValueError("serve.priority must be null or a mapping of "
                              "priority-admission knobs")
         pknown = ("enable", "header", "bulk_max_inflight_frac",
-                  "degrade_shed_rate", "degrade_p99_ms", "bulk_retry_factor")
+                  "degrade_shed_rate", "degrade_p99_ms", "bulk_retry_factor",
+                  "bulk_content_bytes")
         for key in p:
             if key not in pknown:
                 raise ValueError(f"serve.priority: unknown key {key!r} "
@@ -749,6 +805,9 @@ def validate_config(cfg: ConfigDict) -> None:
                              "or > 0")
         if float(p.get("bulk_retry_factor", 4.0)) < 1:
             raise ValueError("serve.priority.bulk_retry_factor must be >= 1")
+        if int(p.get("bulk_content_bytes", 0) or 0) < 0:
+            raise ValueError("serve.priority.bulk_content_bytes must be "
+                             ">= 0 (0 disables)")
     st = s.get("stream")
     if st is not None:
         if not isinstance(st, Mapping):

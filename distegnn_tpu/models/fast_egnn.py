@@ -34,6 +34,7 @@ from distegnn_tpu.ops.edge_pipeline import (EdgeWeights, build_edge_blocks,
 from distegnn_tpu.ops.layer_pipeline import (DEFAULT_STACK_VMEM_BUDGET,
                                              StackConfig, fused_egnn_stack)
 from distegnn_tpu.ops.graph import GraphBatch
+from distegnn_tpu.ops.segment import masked_sum
 from distegnn_tpu.parallel.collectives import (
     global_node_mean, tp_copy, tp_gather, tp_once, tp_reduce, tp_slice,
 )
@@ -229,7 +230,17 @@ class EGCLVel(nn.Module):
         inv_deg: Optional[jnp.ndarray] = None,  # [B, N, 1] 1/max(in-degree, 1)
         oh: Optional[jnp.ndarray] = None,       # [B, nb, epb, block] einsum incidence
         fused_arrs: Optional[Tuple] = None,     # batched build_edge_blocks output
-    ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+        # tiled serving (serve/tiled.py): the layer runs over ONE tile of a
+        # larger scene. tile_coord_mean is the precomputed SCENE-global
+        # coordinate mean (replaces psum #1 — a tile-local mean would be
+        # wrong); tile_partials=True returns the tile's masked-sum
+        # contributions to psums #2/#3 instead of applying them (the
+        # executor closes X/Hv once per layer via tiled_virtual_update).
+        # Correct because every cross-node quantity here (vcd, m_X, vef,
+        # trans_X) is computed from LAYER-INPUT X/Hv/x.
+        tile_coord_mean: Optional[jnp.ndarray] = None,  # [B, 3]
+        tile_partials: bool = False,
+    ) -> Tuple[jnp.ndarray, ...]:
         H, C = self.hidden_nf, self.virtual_channels
         dt = resolve_dtype(self.compute_dtype)
         node_mask = g.node_mask                      # [B, N]
@@ -383,7 +394,8 @@ class EGCLVel(nn.Module):
         virtual_radial = jnp.linalg.norm(vcd, axis=2, keepdims=True)    # [B, N, 1, C]
 
         # ---------- psum #1: exact global coordinate mean (:258-261)
-        coord_mean = global_node_mean(x, node_mask, self.axis_name)     # [B, 3]
+        coord_mean = (tile_coord_mean if tile_coord_mean is not None
+                      else global_node_mean(x, node_mask, self.axis_name))  # [B, 3]
 
         # --- invariant virtual mixing m_X: Gram of centered virtual coords (:263-264)
         Xc = X - coord_mean[:, :, None]                                  # [B, 3, C]
@@ -442,7 +454,10 @@ class EGCLVel(nn.Module):
 
         # ---------- psum #2: virtual coordinate update (coord_model_virtual, :191-200)
         trans_X = vcd * jnp.swapaxes(CoordMLP(H, tanh=self.tanh, name="phi_X", dtype=dt)(vef), 2, 3)  # [B, N, 3, C]
-        X = X + global_node_mean(trans_X, node_mask, self.axis_name)     # [B, 3, C]
+        if tile_partials:
+            transX_part = masked_sum(trans_X, node_mask, axis=1)         # [B, 3, C]
+        else:
+            X = X + global_node_mean(trans_X, node_mask, self.axis_name)  # [B, 3, C]
 
         # --- node feature update (node_model, :203-217)
         agg_h = agg_h_f if agg_h_f is not None else ops.agg_rows_mean(edge_feat)
@@ -457,12 +472,43 @@ class EGCLVel(nn.Module):
         h = h * nm
 
         # ---------- psum #3: virtual feature update (node_model_virtual, :220-234)
+        if tile_partials:
+            # same numerator/denominator as the two global_node_means above,
+            # summed across tiles by the executor — phi_hv is applied there
+            # (flax ignores the unused phi_hv subtree in this mode)
+            vef_part = masked_sum(vef.astype(jnp.float32), node_mask, axis=1)  # [B, C, H]
+            count = jnp.sum(node_mask.astype(jnp.float32), axis=1)       # [B]
+            return h, x, transX_part, vef_part, count
         agg_Hv = global_node_mean(vef.astype(jnp.float32), node_mask, self.axis_name)  # [B, C, H]
         hv_in = jnp.concatenate([jnp.swapaxes(Hv, 1, 2), agg_Hv], axis=-1)  # [B, C, 2H]
         out_v = jnp.swapaxes(MLP([H, H], name="phi_hv", dtype=dt)(hv_in), 1, 2)  # [B, H, C]
         Hv = (Hv + out_v) if self.residual else out_v
 
         return h, x, Hv, X
+
+
+def tiled_virtual_update(gcl_params, Hv, X, transX_sum, vef_sum, count, *,
+                         residual: bool = True,
+                         compute_dtype: Optional[str] = None):
+    """Close one tiled layer's virtual-node state from per-tile partials.
+
+    ``transX_sum`` [B,3,C], ``vef_sum`` [B,C,H] and ``count`` [B] are the
+    sums of the ``tile_partials=True`` outputs over ALL tiles of the scene;
+    dividing by the total count reproduces psums #2/#3 of the monolithic
+    EGCLVel exactly (same numerator, same denominator, different summation
+    order), then phi_hv — whose subtree EGCLVel skipped in tile mode — is
+    applied here, once per layer instead of once per tile."""
+    dt = resolve_dtype(compute_dtype)
+    H = Hv.shape[1]
+    cnt = jnp.maximum(count, 1.0)[:, None, None]
+    X = X + transX_sum / cnt
+    agg_Hv = vef_sum / cnt                                           # [B, C, H]
+    hv_in = jnp.concatenate([jnp.swapaxes(Hv, 1, 2), agg_Hv], axis=-1)
+    out_v = jnp.swapaxes(
+        MLP([H, H], dtype=dt).apply({"params": gcl_params["phi_hv"]},
+                                    hv_in), 1, 2)                    # [B, H, C]
+    Hv = (Hv + out_v) if residual else out_v
+    return Hv, X
 
 
 class FastEGNN(nn.Module):

@@ -21,6 +21,7 @@ from distegnn_tpu.serve.replica import (ModelUnavailableError, Replica,
                                         ReplicaSet, WorkerQueue,
                                         WorkerReplica)
 from distegnn_tpu.serve.supervisor import ReplicaSupervisor
+from distegnn_tpu.serve.tiled import TiledExecutor, TiledOverflowError
 
 __all__ = [
     "Bucket", "BucketLadder", "BucketOverflowError", "synthetic_graph",
@@ -30,6 +31,7 @@ __all__ = [
     "DispatcherCrashError", "WorkerLostError", "ModelUnavailableError",
     "Replica", "ReplicaSet", "WorkerQueue", "WorkerReplica",
     "ReplicaSupervisor", "SwapError", "SwapInProgressError",
+    "TiledExecutor", "TiledOverflowError",
     "engine_from_config", "engine_with_params_from_config", "Gateway",
     "ModelEntry", "ModelRegistry", "PayloadError",
 ]
@@ -71,7 +73,10 @@ def engine_from_config(cfg, model, params, metrics=None):
         cache_size=s.cache_size, donate=s.donate, metrics=metrics,
         rollout_opts=(s.rollout.to_dict() if s.get("rollout") else None),
         layout_opts=layout,
-        session_cache=int(s.get("session_cache", 0) or 0))
+        session_cache=int(s.get("session_cache", 0) or 0),
+        session_cache_bytes=int(s.get("session_cache_bytes", 0) or 0),
+        tiled=(s.tiled.to_dict() if s.get("tiled")
+               and s.tiled.get("enable") else None))
     q = RequestQueue(
         engine, batch_deadline_ms=s.batch_deadline_ms,
         queue_capacity=s.queue_capacity,

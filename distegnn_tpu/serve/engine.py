@@ -86,6 +86,11 @@ class InferenceEngine:
       session_cache: capacity of the session-affinity prep cache
         (serve/prep.py) exposed as ``engine.prep_cache``; 0 (default)
         disables it.
+      session_cache_bytes: byte bound on the prep cache's stored plans
+        (evict-to-fit; 0 = entry-count bound only). Million-node tile
+        plans make the entry count a poor proxy for host RSS.
+      tiled: ``serve.tiled:`` config dict — builds the tiled executor
+        (serve/tiled.py) for scenes above the ladder cap; None disables.
     """
 
     def __init__(self, model, params, *, ladder: Optional[BucketLadder] = None,
@@ -94,7 +99,9 @@ class InferenceEngine:
                  apply_fn: Optional[Callable] = None,
                  rollout_opts: Optional[dict] = None,
                  layout_opts: Optional[dict] = None,
-                 session_cache: int = 0):
+                 session_cache: int = 0,
+                 session_cache_bytes: int = 0,
+                 tiled: Optional[dict] = None):
         if max_batch < 1:
             raise ValueError("max_batch must be >= 1")
         if cache_size < 1:
@@ -117,9 +124,18 @@ class InferenceEngine:
 
             self.prep_cache: Optional[SessionPrepCache] = SessionPrepCache(
                 int(session_cache), ladder=self.ladder,
-                layout_opts=self._layout_opts, metrics=self.metrics)
+                layout_opts=self._layout_opts, metrics=self.metrics,
+                max_bytes=int(session_cache_bytes))
         else:
             self.prep_cache = None
+        # tiled executor (serve/tiled.py): scenes above the ladder cap run
+        # as a scan over fixed-shape tiles instead of 413-rejecting
+        if tiled is not None:
+            from distegnn_tpu.serve.tiled import TiledExecutor
+
+            self.tiled: Optional["TiledExecutor"] = TiledExecutor(self, tiled)
+        else:
+            self.tiled = None
         if donate == "auto":
             donate = jax.default_backend() == "tpu"
         self._donate = bool(donate)
@@ -236,6 +252,27 @@ class InferenceEngine:
 
     def _probe_edge_attr_nf(self) -> int:
         return int(getattr(self.model, "edge_attr_nf", 2) or 0)
+
+    # ---- tiled giant-scene path (serve/tiled.py) ------------------------
+    @property
+    def tiled_enabled(self) -> bool:
+        """True when scenes above the ladder cap dispatch to the tiled
+        executor instead of 413-rejecting."""
+        return self.tiled is not None and self.tiled.enable
+
+    def predict_tiled(self, graph: dict,
+                      request_id: Optional[str] = None,
+                      progress: Optional[Callable] = None) -> dict:
+        """One giant scene through the tile executor. The transport stashes
+        a session-cached plan on the graph as ``_tile_plan``; absent (or
+        built for a different layout) the executor replans inline."""
+        if self.tiled is None:
+            raise RuntimeError(
+                "engine built without serve.tiled config; giant scenes "
+                "cannot be served")
+        plan = graph.pop("_tile_plan", None)
+        return self.tiled.predict(graph, plan=plan, request_id=request_id,
+                                  progress=progress)
 
     @property
     def rollout_enabled(self) -> bool:

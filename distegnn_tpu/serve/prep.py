@@ -34,6 +34,14 @@ peer) rebinds dict keys to new arrays rather than writing through.
 Metrics: hits/misses/evictions are recorded on the engine's `ServeMetrics`
 (``session_hits`` / ``session_misses`` / ``session_evictions``) and land in
 ``GET /metrics`` through the shared obs registry.
+
+Capacity is bounded two ways: an entry-count LRU (``serve.session_cache``)
+and, independently, a BYTE bound (``serve.session_cache_bytes``) accounted
+with :func:`nbytes_of` over each stored plan — a 64-entry LRU of
+million-node tile plans (serve/tiled.py, stored here under ``tile:<sid>``
+keys) is multi-GB host RSS, so the entry count alone is a poor proxy.
+Inserts evict-to-fit from the LRU tail; the live total is exported as the
+``serve/session_cache_bytes`` gauge on /metrics.
 """
 
 from __future__ import annotations
@@ -55,6 +63,19 @@ from distegnn_tpu.serve.metrics import ServeMetrics
 
 def _round_up(x: int, m: int) -> int:
     return -(-x // m) * m
+
+
+def nbytes_of(obj) -> int:
+    """Recursive host-memory estimate of a cached plan: every numpy array's
+    ``nbytes``, walked through tuples/NamedTuples/lists/dicts. Scalars and
+    tiny metadata round to 0 — arrays are what dominate a plan."""
+    if isinstance(obj, np.ndarray):
+        return int(obj.nbytes)
+    if isinstance(obj, dict):
+        return sum(nbytes_of(v) for v in obj.values())
+    if isinstance(obj, (tuple, list)):
+        return sum(nbytes_of(v) for v in obj)
+    return 0
 
 
 def topology_fingerprint(edge_index: np.ndarray, n_nodes: int) -> tuple:
@@ -95,10 +116,12 @@ class SessionPrepCache:
 
     def __init__(self, capacity: int, *, ladder: BucketLadder,
                  layout_opts: Optional[dict] = None,
-                 metrics: Optional[ServeMetrics] = None, bits: int = 16):
+                 metrics: Optional[ServeMetrics] = None, bits: int = 16,
+                 max_bytes: int = 0):
         if capacity < 1:
             raise ValueError("SessionPrepCache: capacity must be >= 1")
         self.capacity = int(capacity)
+        self.max_bytes = int(max_bytes)   # 0 = entry-count bound only
         self.ladder = ladder
         self.metrics = metrics
         self.bits = int(bits)
@@ -106,12 +129,46 @@ class SessionPrepCache:
         self.edge_block = int(opts.get("edge_block", 0))
         self.edge_tile = int(opts.get("edge_tile", 512))
         self.split_remote = bool(opts.get("split_remote", False))
-        self._plans: "OrderedDict[str, PrepPlan]" = OrderedDict()
+        self._plans: "OrderedDict[str, object]" = OrderedDict()
+        self._sizes: dict = {}
+        self._bytes = 0
+        self._g_bytes = (metrics.registry.gauge("serve/session_cache_bytes")
+                         if metrics is not None else None)
         self._lock = threading.Lock()
 
     def __len__(self) -> int:
         with self._lock:
             return len(self._plans)
+
+    @property
+    def bytes_used(self) -> int:
+        with self._lock:
+            return self._bytes
+
+    def _insert(self, key: str, plan) -> int:
+        """LRU insert with byte accounting: frees the key's old entry (a
+        same-session replace is not an eviction), then evicts from the LRU
+        tail until both the entry-count and byte bounds admit the new plan.
+        Returns the number of OTHER entries evicted."""
+        size = nbytes_of(plan)
+        with self._lock:
+            if key in self._plans:
+                self._bytes -= self._sizes.pop(key, 0)
+                self._plans.pop(key)
+            evicted = 0
+            while self._plans and (
+                    len(self._plans) >= self.capacity
+                    or (self.max_bytes
+                        and self._bytes + size > self.max_bytes)):
+                k, _ = self._plans.popitem(last=False)
+                self._bytes -= self._sizes.pop(k, 0)
+                evicted += 1
+            self._plans[key] = plan
+            self._sizes[key] = size
+            self._bytes += size
+            if self._g_bytes is not None:
+                self._g_bytes.set(self._bytes)
+        return evicted
 
     # ---- plan building ---------------------------------------------------
     def _build(self, graph: dict, fp: tuple) -> PrepPlan:
@@ -199,16 +256,7 @@ class SessionPrepCache:
                 plan = None
         if plan is None:
             plan = self._build(graph, fp)
-            with self._lock:
-                evicted = 0
-                # replacing a stale plan for the SAME session is not an
-                # eviction — only capacity pressure on other sessions is
-                if session_id not in self._plans:
-                    while len(self._plans) >= self.capacity:
-                        self._plans.popitem(last=False)
-                        evicted += 1
-                self._plans[session_id] = plan
-                self._plans.move_to_end(session_id)
+            evicted = self._insert(session_id, plan)
             hit = False
         if self.metrics is not None:
             self.metrics.session_event(hit=hit, evicted=evicted)
@@ -218,3 +266,33 @@ class SessionPrepCache:
         obs.event("serve/prep", session=str(session_id), hit=hit,
                   dur_s=round(time.perf_counter() - t0, 6), **attrs)
         return result
+
+    # ---- tiled giant-scene plans (serve/tiled.py) ------------------------
+    def prepare_tile(self, session_id: str, graph: dict, build,
+                     request_id: Optional[str] = None):
+        """Session-cached tile plan for a giant scene: same fingerprint
+        contract and metrics as :meth:`prepare`, stored in the SAME LRU +
+        byte budget under a ``tile:`` key (tile plans are the entries the
+        byte bound exists for). ``build`` is a zero-arg plan builder (the
+        tiled executor's ``plan``); returns ``(plan, hit)``."""
+        t0 = time.perf_counter()
+        fp = topology_fingerprint(graph["edge_index"], graph["loc"].shape[0])
+        key = "tile:" + str(session_id)
+        with self._lock:
+            ent = self._plans.get(key)
+            if ent is not None and ent[0] == fp:
+                self._plans.move_to_end(key)
+                plan, hit, evicted = ent[1], True, 0
+            else:
+                plan = None
+        if plan is None:
+            plan = build()
+            evicted = self._insert(key, (fp, plan))
+            hit = False
+        if self.metrics is not None:
+            self.metrics.session_event(hit=hit, evicted=evicted)
+        attrs = {"request_id": request_id} if request_id is not None else {}
+        obs.event("serve/prep", session=str(session_id), hit=hit,
+                  plan_kind="tile_plan",
+                  dur_s=round(time.perf_counter() - t0, 6), **attrs)
+        return plan, hit

@@ -49,7 +49,8 @@ PROMOTER = os.path.join(REPO, "distegnn_tpu", "promote", "promoter.py")
 CONFIGS = os.path.join(REPO, "configs")
 
 # serve.<section> mappings whose validators own an unknown-key guard
-SECTIONS = ("worker", "supervisor", "autoscale", "priority", "stream")
+SECTIONS = ("worker", "supervisor", "autoscale", "priority", "stream",
+            "tiled")
 
 # top-level _DEFAULTS mappings with the same lockstep contract, bound in
 # validate_config via <var> = cfg.get("<section>")

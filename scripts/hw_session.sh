@@ -339,6 +339,25 @@ EOF
   cp /tmp/bench_mesh3d_last.json \
      "docs/artifacts/bench_mesh3d_$(date -u +%Y%m%dT%H%M%S).json"
 }
+# 0b2. tiled-serving leg (serve/tiled.py): giant-scene inference nodes/sec
+#      through the fixed-shape tile executor, with tile count, halo
+#      fraction and the H2D-overlap stall fraction measured on real chips —
+#      the hardware evidence for the million-node serving path. The check
+#      requires a real throughput AND that double-buffered staging actually
+#      overlapped (stall fraction < 0.5 of the pass).
+tiled_leg_and_check() {
+  python bench.py --layout tiled | tee /tmp/bench_tiled_last.json
+  python - <<'EOF' || return 1
+import json
+line = [l for l in open('/tmp/bench_tiled_last.json') if l.strip().startswith('{')][-1]
+rec = json.loads(line)
+raise SystemExit(0 if rec['value'] > 0 and rec['tiles'] >= 2
+                 and rec['h2d_stall_fraction'] < 0.5 else 1)
+EOF
+  mkdir -p docs/artifacts
+  cp /tmp/bench_tiled_last.json \
+     "docs/artifacts/bench_tiled_$(date -u +%Y%m%dT%H%M%S).json"
+}
 # 0c. input-pipeline leg (data/stream.py): streamed-shard prefetch vs
 #     blocking put, graphs/s + data/stall_s fractions on THIS host's disk.
 #     The check requires the prefetch stall to not exceed the blocking stall
@@ -357,10 +376,12 @@ EOF
      "docs/artifacts/bench_io_$(date -u +%Y%m%dT%H%M%S).json"
 }
 export -f mesh3d_leg_and_check fused_leg_and_check stack_leg_and_check \
-          io_leg_and_check bench_and_check  # run_bounded's bash -c needs them
+          tiled_leg_and_check io_leg_and_check \
+          bench_and_check  # run_bounded's bash -c needs them
 run_bounded bench_fused fused_leg_and_check
 run_bounded bench_fused_stack stack_leg_and_check
 run_bounded bench_mesh3d mesh3d_leg_and_check
+run_bounded bench_tiled tiled_leg_and_check
 run_bounded bench_io io_leg_and_check
 # 1. headline bench: auto races fused / plain-cumsum stacks / plain-scatter
 #    anchor in child processes (bench.RACE_ORDER) and reports the fastest

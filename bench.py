@@ -165,8 +165,11 @@ RACE_ORDER = (
     # giant-scene tile executor — tile count, halo fraction and the
     # H2D-overlap stall fraction on this session's hardware. Its metric is
     # tiled_serve_nodes_per_sec (an INFERENCE number), which never contends
-    # for the race's training headline.
-    (["--layout", "tiled"], None),
+    # for the race's training headline. BENCH_TILED_DEVICES=8 adds the
+    # device sweep (D=1 anchor + D=min(8, devices, tiles) mesh rounds +
+    # scaling_efficiency); on CPU bench provisions virtual devices, so the
+    # sweep is plumbing evidence there, not a speedup claim.
+    (["--layout", "tiled"], {"BENCH_TILED_DEVICES": "8"}),
     # Input-pipeline leg LAST (host-side graphs/s + stall fractions for the
     # streamed-shard prefetch A/B, data/stream.py): its metric is
     # io_pipeline_graphs_per_sec, which never contends for the race's
@@ -595,7 +598,11 @@ def measure_tiled():
     halo fraction, H2D-overlap stall fraction). An INFERENCE number, never
     the training headline. Self-caps via BENCH_TILED_NODES; tile size via
     BENCH_TILE_NODES (default N/6 so the leg always actually tiles);
-    BENCH_TILED_IMPL=fused runs the halo-aware fused edge pipeline."""
+    BENCH_TILED_IMPL=fused runs the halo-aware fused edge pipeline;
+    BENCH_TILED_DEVICES>1 adds the device sweep — the same scene rerun
+    through D device-parallel rounds (serve/mesh_tiled.py) with the D=1
+    number kept as seq_nodes_per_sec and scaling_efficiency =
+    (mesh/seq)/D."""
     import jax
 
     from distegnn_tpu.models.fast_egnn import FastEGNN
@@ -650,7 +657,7 @@ def measure_tiled():
 
     nodes_per_sec = N_NODES * steps / dt
     platform = jax.devices()[0].platform
-    return {
+    rec = {
         "metric": "tiled_serve_nodes_per_sec",
         "value": round(nodes_per_sec, 1),
         "unit": (f"inference nodes/sec through the tiled executor "
@@ -666,7 +673,46 @@ def measure_tiled():
         "h2d_stall_fraction": round(out["stall_fraction"], 4),
         "work_imbalance": round(out["work_imbalance"], 4),
         "pass_ms": round(dt / steps * 1e3, 1),
+        "devices": 1,
+        "tiled_rounds": out["rounds"],
+        "scaling_efficiency": None,
     }
+
+    # device sweep (serve/mesh_tiled.py): rerun the SAME scene and plan at
+    # D = min(BENCH_TILED_DEVICES, local devices, tiles). The headline value
+    # becomes the D-device number; seq_nodes_per_sec keeps the D=1 anchor and
+    # scaling_efficiency = (mesh/seq)/D. On CPU this traces the mesh path
+    # only — virtual devices share one host, so the ratio is evidence-grade
+    # plumbing proof, never a speedup claim (BASELINE.md rules); real
+    # multi-chip numbers come from the hw_session bench_tiled_mesh leg.
+    req = _env_int("BENCH_TILED_DEVICES", 0)
+    D = min(req, jax.local_device_count(), out["tiles"])
+    if D > 1:
+        tx.devices = D
+        mout = tx.predict(dict(cloud))       # warmup: pmap compile
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            mout = tx.predict(dict(cloud))
+        mdt = time.perf_counter() - t0
+        mesh_nps = N_NODES * steps / mdt
+        rec.update({
+            "value": round(mesh_nps, 1),
+            "unit": (f"inference nodes/sec through the tiled executor at "
+                     f"D={D} device-parallel rounds (N={N_NODES}, "
+                     f"E={n_edges}, tiles={out['tiles']} -> "
+                     f"{mout['rounds']} rounds, impl={impl}, "
+                     f"layers={LAYERS}, platform={platform}; serving leg; "
+                     f"CPU sweep is plumbing evidence, not a speedup claim)"),
+            "devices": D,
+            "tiled_rounds": mout["rounds"],
+            "seq_nodes_per_sec": round(nodes_per_sec, 1),
+            "scaling_efficiency": round((mesh_nps / nodes_per_sec) / D, 4),
+            "round_ms": round(mout["round_ms"], 2),
+            "halo_gather_ms": round(mout["halo_gather_ms"], 2),
+            "h2d_stall_fraction": round(mout["stall_fraction"], 4),
+            "pass_ms": round(mdt / steps * 1e3, 1),
+        })
+    return rec
 
 
 def main():
@@ -784,7 +830,23 @@ def main():
         return
     if layout == "tiled":
         # giant-scene serving leg (tile executor nodes/sec + halo/stall
-        # gauges); an inference number, never the training headline
+        # gauges); an inference number, never the training headline.
+        # BENCH_TILED_DEVICES>1 on CPU needs virtual devices provisioned
+        # BEFORE the backend initializes (same contract as the mesh leg);
+        # harmless no-op on real hardware.
+        dneed = _env_int("BENCH_TILED_DEVICES", 0)
+        if dneed > 1 and (plat == "cpu"
+                          or os.environ.get("JAX_PLATFORMS") == "cpu"):
+            import jax
+
+            try:
+                jax.config.update("jax_num_cpu_devices", dneed)
+            except (RuntimeError, AttributeError):
+                if "--xla_force_host_platform_device_count" not in \
+                        os.environ.get("XLA_FLAGS", ""):
+                    os.environ["XLA_FLAGS"] = (
+                        os.environ.get("XLA_FLAGS", "")
+                        + f" --xla_force_host_platform_device_count={dneed}")
         _emit_bench(measure_tiled())
         return
     if layout == "io":

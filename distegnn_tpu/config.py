@@ -232,6 +232,12 @@ _DEFAULTS: dict = {
             # tiled requests run L x n_tiles invocations: their queue/result
             # deadlines stretch by this factor over request_timeout_ms
             "timeout_factor": 8.0,
+            # device-parallel tile rounds (serve/mesh_tiled.py): 'auto'
+            # takes every local device, N is clamped to what exists, 1
+            # keeps the sequential single-device tile loop. Plans are
+            # device-count-independent, so this can change per deploy
+            # without invalidating session-cached tile plans.
+            "devices": 1,
         },
         # shared-nothing engine replicas per model (serve/replica.py): each
         # replica owns its own engine + dispatcher queue behind one
@@ -675,7 +681,7 @@ def validate_config(cfg: ConfigDict) -> None:
             raise ValueError("serve.tiled must be null or a mapping of "
                              "tiled-executor knobs")
         tknown = ("enable", "max_nodes", "tile_nodes", "halo_floor",
-                  "edge_floor", "growth", "timeout_factor")
+                  "edge_floor", "growth", "timeout_factor", "devices")
         for key in t:
             if key not in tknown:
                 raise ValueError(f"serve.tiled: unknown key {key!r} "
@@ -693,6 +699,11 @@ def validate_config(cfg: ConfigDict) -> None:
             raise ValueError("serve.tiled.growth must be > 1")
         if float(t.get("timeout_factor", 8.0)) < 1.0:
             raise ValueError("serve.tiled.timeout_factor must be >= 1")
+        td = t.get("devices", 1)
+        if td != "auto" and (isinstance(td, bool) or not isinstance(td, int)
+                             or td < 1):
+            raise ValueError("serve.tiled.devices must be 'auto' or an "
+                             "int >= 1")
     r = s.get("rollout")
     if r is not None:
         if not isinstance(r, Mapping):

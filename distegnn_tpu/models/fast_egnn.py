@@ -511,6 +511,23 @@ def tiled_virtual_update(gcl_params, Hv, X, transX_sum, vef_sum, count, *,
     return Hv, X
 
 
+def reduce_tile_partials(transX_part, vef_part, count, valid, axis_name):
+    """Cross-device reduction of one tile ROUND's virtual-node partials
+    (serve/mesh_tiled.py): each device of the round holds ONE tile's
+    ``tile_partials=True`` outputs; masking by the slot's validity flag
+    (ragged rounds carry zero-filled pad slots — their node_mask is already
+    all-zero, the flag hard-guarantees it) and psumming over the round's
+    device axis gives every device the round's summed partials. The host
+    accumulates these round sums across rounds and feeds the layer total to
+    :func:`tiled_virtual_update` — the same numerators/denominator as the
+    sequential per-tile accumulation, in a different summation order."""
+    v = valid.astype(jnp.float32)
+    transX = jax.lax.psum(transX_part * v, axis_name)
+    vef = jax.lax.psum(vef_part * v, axis_name)
+    cnt = jax.lax.psum(count * v, axis_name)
+    return transX, vef, cnt
+
+
 class FastEGNN(nn.Module):
     """FastEGNN / DistEGNN wrapper (reference models/FastEGNN.py:279-307).
 

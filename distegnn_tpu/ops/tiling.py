@@ -114,6 +114,68 @@ class TilePlan(NamedTuple):
                 self.edges_per_block, self.remote_pad)
 
 
+class RoundSchedule(NamedTuple):
+    """Device-parallel execution order for one :class:`TilePlan`: the plan's
+    tiles grouped into ``ceil(T / D)`` *rounds* of at most ``n_devices``
+    tiles each. Every tile of a round runs simultaneously, one per device,
+    through ONE shard-mapped tile executable (serve/mesh_tiled.py) — legal
+    because all tiles share the plan's single padded shape, and exact
+    because every tile reads LAYER-INPUT state (tile order never matters
+    within a layer). Rounds are LPT-balanced on the plan's work model so the
+    host-side halo gather + readback cost of the heaviest round never
+    dominates; a round with fewer than ``n_devices`` tiles (``T % D != 0``)
+    pads its free slots with zero-masked filler tiles, hard-masked by a
+    per-slot validity flag."""
+
+    rounds: Tuple[Tuple[int, ...], ...]   # tile indices per round, each <= D
+    n_devices: int
+    round_imbalance: float    # max/mean per-round work under the work model
+
+    @property
+    def n_rounds(self) -> int:
+        return len(self.rounds)
+
+
+def tile_work(plan: TilePlan) -> np.ndarray:
+    """Per-tile work under the ``node_work`` model with unit costs
+    (``a + b*deg`` summed over a tile = own nodes + received edges).
+    Recomputed from the tile specs — NOT stored on the plan — so plans stay
+    device-count-independent (a plan cached at ``devices: 1`` schedules at
+    any D without a rebuild)."""
+    return np.asarray(
+        [s.n_own + s.edge_index.shape[1] for s in plan.tiles], np.float64)
+
+
+def plan_rounds(plan: TilePlan, n_devices: int,
+                work: Optional[np.ndarray] = None) -> RoundSchedule:
+    """Group ``plan``'s tiles into ``ceil(T / D)`` rounds of at most
+    ``n_devices`` via LPT (longest-processing-time-first): tiles in
+    descending work order each land in the least-loaded round with a free
+    slot. Deterministic (stable sort + first-min tie-break). The per-tile
+    COMPUTE is shape-identical by construction; what LPT balances is the
+    per-round host work — halo gather bytes and result readback scale with
+    a round's real (unpadded) nodes + edges."""
+    D = max(int(n_devices), 1)
+    T = plan.n_tiles
+    if work is None:
+        work = tile_work(plan)
+    work = np.asarray(work, np.float64)
+    if work.shape[0] != T:
+        raise ValueError(f"plan_rounds: work has {work.shape[0]} entries "
+                         f"for {T} tiles")
+    R = -(-T // D)
+    loads = np.zeros(R, np.float64)
+    slots: list = [[] for _ in range(R)]
+    for t in np.argsort(-work, kind="stable"):
+        free = [r for r in range(R) if len(slots[r]) < D]
+        ri = min(free, key=lambda r: (loads[r], r))
+        slots[ri].append(int(t))
+        loads[ri] += work[t]
+    rounds = tuple(tuple(sorted(s)) for s in slots)
+    imb = float(loads.max() / max(loads.mean(), 1e-30))
+    return RoundSchedule(rounds=rounds, n_devices=D, round_imbalance=imb)
+
+
 def plan_tiles(edge_index: np.ndarray, loc: np.ndarray,
                edge_attr: Optional[np.ndarray] = None, *,
                tile_nodes: int = 65536, halo_floor: int = 1024,

@@ -265,7 +265,10 @@ class InferenceEngine:
                       progress: Optional[Callable] = None) -> dict:
         """One giant scene through the tile executor. The transport stashes
         a session-cached plan on the graph as ``_tile_plan``; absent (or
-        built for a different layout) the executor replans inline."""
+        built for a different layout) the executor replans inline. Plans
+        carry no device count, so the same cached plan serves sequentially
+        or as device-parallel rounds (``serve.tiled.devices``,
+        serve/mesh_tiled.py) unchanged."""
         if self.tiled is None:
             raise RuntimeError(
                 "engine built without serve.tiled config; giant scenes "

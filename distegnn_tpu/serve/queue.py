@@ -430,9 +430,11 @@ class RequestQueue:
         must admit sitting behind another giant scene.
 
         With ``stream`` (a :class:`StreamSink`), per-tile progress arrives
-        on the sink as ``(layer, tile)`` chunks and a ``stream.cancel()``
-        stops the remaining tiles at the next tile boundary (the streamed-
-        rollout disconnect contract)."""
+        on the sink as ``(layer, tile)`` chunks — or per-ROUND
+        ``(layer, round)`` chunks when the executor runs device-parallel
+        rounds (``serve.tiled.devices`` > 1, serve/mesh_tiled.py) — and a
+        ``stream.cancel()`` stops the remaining compute at the next
+        tile/round boundary (the streamed-rollout disconnect contract)."""
         if not self._started:
             raise RuntimeError("RequestQueue not started (use start() or a "
                                "with-block)")

@@ -609,7 +609,10 @@ class ReplicaSet:
                      stream=None) -> ServeFuture:
         """Above-ladder predict through the tiled executor. Runs only on
         in-process replicas (the host-side halo exchange loop can't cross
-        the worker IPC channel)."""
+        the worker IPC channel). ``serve.tiled.devices`` > 1 parallelizes
+        WITHIN one request (device-parallel tile rounds, serve/
+        mesh_tiled.py) — orthogonal to replica-level parallelism across
+        requests, which keeps giant scenes on dedicated engines."""
         return self._admit("tiled", graph, None, request_id, stream=stream)
 
     # ---- elastic membership (autoscaler surface) -------------------------

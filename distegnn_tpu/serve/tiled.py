@@ -50,6 +50,8 @@ TILED_DEFAULTS = {
     "edge_floor": 8192,         # plain-layout edge rung floor
     "growth": 2.0,              # rung growth factor (matches the ladder)
     "timeout_factor": 8.0,      # tiled deadline = factor * request_timeout
+    "devices": 1,               # 'auto'|N: device-parallel tile rounds
+                                # (serve/mesh_tiled.py); 1 = sequential
 }
 
 
@@ -80,6 +82,12 @@ class TiledExecutor:
         self.edge_floor = int(c["edge_floor"])
         self.growth = float(c["growth"])
         self.timeout_factor = float(c["timeout_factor"])
+        # 'auto' | int: device-parallel tile rounds (serve/mesh_tiled.py).
+        # Resolved per predict against the live device count — plans and
+        # shape_key are device-count-independent, so the same (possibly
+        # session-cached) plan serves at any setting.
+        self.devices = c["devices"] if c["devices"] == "auto" \
+            else int(c["devices"])
         layout = dict(getattr(engine, "_layout_opts", {}) or {})
         model = engine.model
         impl = str(getattr(model, "edge_impl", "plain") or "plain")
@@ -93,6 +101,11 @@ class TiledExecutor:
         self._g_tiles = g("serve/tiled_tiles")
         self._g_halo = g("serve/tiled_halo_fraction")
         self._g_stall = g("serve/tiled_stall_fraction")
+        # mesh-round gauges (serve/mesh_tiled.py): devices used by the last
+        # tiled predict, mean compute ms per round, host halo-gather ms
+        self._g_devices = g("serve/tiled_devices")
+        self._g_round_ms = g("serve/tiled_round_ms")
+        self._g_halo_gather = g("serve/tiled_halo_gather_ms")
 
     # ---- admission -------------------------------------------------------
     def check_admit(self, n: int) -> None:
@@ -174,11 +187,12 @@ class TiledExecutor:
 
         return self.engine._compiled(("tile_embed", tn, feat_nf, H), build)
 
-    def _layer_fn(self, plan: TilePlan):
-        """THE tile executable: one EGCL layer over one tile, returning
-        (h', x', transX_partial, vef_partial, count). Keyed on the plan's
-        shape rung + the model's layer config — every tile of every layer
-        of every scene on the same rung shares this one program."""
+    def _layer_callable(self, plan: TilePlan):
+        """The un-jitted single-tile layer fn: one EGCL layer over one
+        tile's padded batch, returning (h', x', transX_partial,
+        vef_partial, count). Shared verbatim by the sequential executable
+        (``_layer_fn`` jits it) and the device-parallel round executable
+        (serve/mesh_tiled.py pmaps it over a round of D tiles)."""
         from distegnn_tpu.models.fast_egnn import EGCLVel
         from distegnn_tpu.ops.blocked import blocked_slot_inv_deg
         from distegnn_tpu.ops.edge_pipeline import build_edge_blocks
@@ -206,27 +220,34 @@ class TiledExecutor:
             agg_dtype=getattr(model, "agg_dtype", None),
             edge_impl=impl)
 
-        def build():
-            def fn(gcl_params, h, x, batch, X, Hv, cm):
-                slot, inv_deg, oh = blocked_slot_inv_deg(batch, blocked_impl)
-                fused_arrs = None
-                if impl == "fused":
-                    fused_arrs = jax.vmap(
-                        lambda r, c, ea, em: build_edge_blocks(
-                            r, c, ea, em, block=batch.edge_block,
-                            n_nodes=batch.max_nodes)
-                    )(batch.row, batch.col, batch.edge_attr, batch.edge_mask)
-                return layer.apply(
-                    {"params": gcl_params}, h, x, batch.vel, X, Hv, batch,
-                    gravity=gravity, slot=slot, inv_deg=inv_deg, oh=oh,
-                    fused_arrs=fused_arrs, tile_coord_mean=cm,
-                    tile_partials=True)
+        def fn(gcl_params, h, x, batch, X, Hv, cm):
+            slot, inv_deg, oh = blocked_slot_inv_deg(batch, blocked_impl)
+            fused_arrs = None
+            if impl == "fused":
+                fused_arrs = jax.vmap(
+                    lambda r, c, ea, em: build_edge_blocks(
+                        r, c, ea, em, block=batch.edge_block,
+                        n_nodes=batch.max_nodes)
+                )(batch.row, batch.col, batch.edge_attr, batch.edge_mask)
+            return layer.apply(
+                {"params": gcl_params}, h, x, batch.vel, X, Hv, batch,
+                gravity=gravity, slot=slot, inv_deg=inv_deg, oh=oh,
+                fused_arrs=fused_arrs, tile_coord_mean=cm,
+                tile_partials=True)
 
-            return jax.jit(fn)
+        return fn
 
+    def _layer_fn(self, plan: TilePlan):
+        """THE sequential tile executable: one EGCL layer over one tile.
+        Keyed on the plan's shape rung + the model's layer config — every
+        tile of every layer of every scene on the same rung shares this one
+        program (the round executable extends this key with D)."""
+        model = self.engine.model
         key = ("tile_layer",) + plan.shape_key + (
-            impl, int(model.hidden_nf), int(model.virtual_channels))
-        return self.engine._compiled(key, build)
+            self.edge_impl, int(model.hidden_nf),
+            int(model.virtual_channels))
+        return self.engine._compiled(
+            key, lambda: jax.jit(self._layer_callable(plan)))
 
     def _virtual_fn(self):
         from distegnn_tpu.models.fast_egnn import tiled_virtual_update
@@ -304,84 +325,49 @@ class TiledExecutor:
             X = jnp.repeat(jnp.asarray(loc_mean)[:, :, None], C, axis=2)
             Hv = jnp.asarray(params["virtual_node_feat"])          # [1, H, C]
 
-            layer_fn = self._layer_fn(plan)
             virt_fn = self._virtual_fn()
 
-            def stage(t: int, h_src: np.ndarray, x_src: np.ndarray):
-                """Gather tile t's layer inputs and start their H2D; returns
-                device handles (transfer proceeds async under compute)."""
-                s = plan.tiles[t]
-                nd = batches[t].node_mask.shape[1]
-                h_t = np.zeros((1, nd, H), np.float32)
-                x_t = np.zeros((1, nd, 3), np.float32)
-                h_t[0, :s.n_own] = h_src[s.start:s.stop]
-                x_t[0, :s.n_own] = x_src[s.start:s.stop]
-                hh = int(s.halo.shape[0])
-                if hh:
-                    h_t[0, plan.tile_nodes:plan.tile_nodes + hh] = h_src[s.halo]
-                    x_t[0, plan.tile_nodes:plan.tile_nodes + hh] = x_src[s.halo]
-                return jax.device_put((h_t, x_t, batches[t]))
+            # device-parallel tile rounds (serve/mesh_tiled.py): D same-
+            # shape tiles at once across D devices, behind the same plan,
+            # session cache, and queue/gateway contracts
+            from distegnn_tpu.serve import mesh_tiled
 
-            stall_s = 0.0
-            cancelled = False
-            t_loop = time.perf_counter()
-            for li in range(L):
-                # psum #1 host-side: the SCENE-global coordinate mean of the
-                # layer input (a tile-local mean would be wrong)
-                cm = jnp.asarray(x_full.mean(axis=0, dtype=np.float64)
-                                 .astype(np.float32)[None])
-                h_next = np.empty_like(h_full)
-                x_next = np.empty_like(x_full)
-                tx_l = np.zeros((1, 3, C), np.float32)
-                vf_l = np.zeros((1, C, H), np.float32)
-                ct_l = np.zeros((1,), np.float32)
-                staged = stage(0, h_full, x_full)
-                for ti, s in enumerate(plan.tiles):
-                    tb = time.perf_counter()
-                    jax.block_until_ready(staged)   # residual un-hidden H2D
-                    stall_s += time.perf_counter() - tb
-                    h_d, x_d, b_d = staged
-                    out = layer_fn(gcls[li], h_d, x_d, b_d, X, Hv, cm)
-                    # double buffer: tile ti+1's H2D overlaps this compute.
-                    # Later tiles read h_full/x_full (the LAYER INPUT), never
-                    # h_next — that is what makes tiling exact.
-                    staged = (stage(ti + 1, h_full, x_full)
-                              if ti + 1 < T else None)
-                    h_o, x_o, tx_p, vf_p, ct_p = [np.asarray(o) for o in out]
-                    h_next[s.start:s.stop] = h_o[0, :s.n_own]
-                    x_next[s.start:s.stop] = x_o[0, :s.n_own]
-                    tx_l += tx_p
-                    vf_l += vf_p
-                    ct_l += ct_p
-                    if progress is not None:
-                        ok = progress(layer=li, tile=ti, n_layers=L,
-                                      n_tiles=T)
-                        if ok is False:
-                            cancelled = True
-                            break
-                if cancelled:
-                    break
-                h_full, x_full = h_next, x_next
-                # close the layer's virtual state from the tile partials —
-                # the scene-wide psums #2/#3, applied exactly once
-                Hv, X = virt_fn(gcls[li], Hv, X, jnp.asarray(tx_l),
-                                jnp.asarray(vf_l), jnp.asarray(ct_l))
-            loop_s = max(time.perf_counter() - t_loop, 1e-9)
-            stall_frac = min(stall_s / loop_s, 1.0)
-            sp.set(stall_fraction=round(stall_frac, 4),
-                   cancelled=cancelled)
+            D = mesh_tiled.resolve_devices(self.devices, n_tiles=T)
+            mesh_stats = None
+            if D > 1:
+                h_full, x_full, mesh_stats, cancelled = mesh_tiled.run_rounds(
+                    self, plan, batches, h_full, x_full, X, Hv, gcls, L,
+                    virt_fn, progress=progress, n_devices=D)
+                stall_frac = mesh_stats["stall_fraction"]
+                rounds = mesh_stats["rounds"]
+                sp.set(stall_fraction=round(stall_frac, 4),
+                       cancelled=cancelled, devices=D, rounds=rounds,
+                       round_ms=round(mesh_stats["round_ms"], 3))
+            else:
+                h_full, x_full, stall_frac, cancelled = self._run_sequential(
+                    plan, batches, h_full, x_full, X, Hv, gcls, L, T, H, C,
+                    virt_fn, progress)
+                rounds = T      # each sequential tile is its own round
+                sp.set(stall_fraction=round(stall_frac, 4),
+                       cancelled=cancelled)
 
         self._g_tiles.set(T)
         self._g_halo.set(round(plan.halo_fraction, 6))
         self._g_stall.set(round(stall_frac, 6))
+        self._g_devices.set(D)
+        if mesh_stats is not None:
+            self._g_round_ms.set(round(mesh_stats["round_ms"], 3))
+            self._g_halo_gather.set(round(mesh_stats["halo_gather_ms"], 3))
         out = None
         if not cancelled:
             out = np.ascontiguousarray(x_full[plan.inv_perm])
-        return {
+        result = {
             "prediction": out,
             "n": n,
             "tiles": T,
             "layers": L,
+            "devices": D,
+            "rounds": rounds,
             "padded_nodes": plan.padded_nodes,
             "halo_fraction": plan.halo_fraction,
             "work_imbalance": plan.work_imbalance,
@@ -390,3 +376,80 @@ class TiledExecutor:
             "total_ms": (time.perf_counter() - t0) * 1e3,
             "cancelled": cancelled,
         }
+        if mesh_stats is not None:
+            result["round_ms"] = mesh_stats["round_ms"]
+            result["halo_gather_ms"] = mesh_stats["halo_gather_ms"]
+            result["round_imbalance"] = mesh_stats["round_imbalance"]
+        return result
+
+    def _run_sequential(self, plan: TilePlan, batches, h_full, x_full,
+                        X, Hv, gcls, L: int, T: int, H: int, C: int,
+                        virt_fn, progress):
+        """The single-device tile loop: one tile at a time through the
+        jitted layer executable, double-buffered H2D, per-tile progress.
+        Kept verbatim from the pre-mesh executor — ``devices: 1`` and the
+        D=1 mesh resolution both land here, so nothing changes for
+        single-chip serving."""
+        layer_fn = self._layer_fn(plan)
+
+        def stage(t: int, h_src: np.ndarray, x_src: np.ndarray):
+            """Gather tile t's layer inputs and start their H2D; returns
+            device handles (transfer proceeds async under compute)."""
+            s = plan.tiles[t]
+            nd = batches[t].node_mask.shape[1]
+            h_t = np.zeros((1, nd, H), np.float32)
+            x_t = np.zeros((1, nd, 3), np.float32)
+            h_t[0, :s.n_own] = h_src[s.start:s.stop]
+            x_t[0, :s.n_own] = x_src[s.start:s.stop]
+            hh = int(s.halo.shape[0])
+            if hh:
+                h_t[0, plan.tile_nodes:plan.tile_nodes + hh] = h_src[s.halo]
+                x_t[0, plan.tile_nodes:plan.tile_nodes + hh] = x_src[s.halo]
+            return jax.device_put((h_t, x_t, batches[t]))
+
+        stall_s = 0.0
+        cancelled = False
+        t_loop = time.perf_counter()
+        for li in range(L):
+            # psum #1 host-side: the SCENE-global coordinate mean of the
+            # layer input (a tile-local mean would be wrong)
+            cm = jnp.asarray(x_full.mean(axis=0, dtype=np.float64)
+                             .astype(np.float32)[None])
+            h_next = np.empty_like(h_full)
+            x_next = np.empty_like(x_full)
+            tx_l = np.zeros((1, 3, C), np.float32)
+            vf_l = np.zeros((1, C, H), np.float32)
+            ct_l = np.zeros((1,), np.float32)
+            staged = stage(0, h_full, x_full)
+            for ti, s in enumerate(plan.tiles):
+                tb = time.perf_counter()
+                jax.block_until_ready(staged)   # residual un-hidden H2D
+                stall_s += time.perf_counter() - tb
+                h_d, x_d, b_d = staged
+                out = layer_fn(gcls[li], h_d, x_d, b_d, X, Hv, cm)
+                # double buffer: tile ti+1's H2D overlaps this compute.
+                # Later tiles read h_full/x_full (the LAYER INPUT), never
+                # h_next — that is what makes tiling exact.
+                staged = (stage(ti + 1, h_full, x_full)
+                          if ti + 1 < T else None)
+                h_o, x_o, tx_p, vf_p, ct_p = [np.asarray(o) for o in out]
+                h_next[s.start:s.stop] = h_o[0, :s.n_own]
+                x_next[s.start:s.stop] = x_o[0, :s.n_own]
+                tx_l += tx_p
+                vf_l += vf_p
+                ct_l += ct_p
+                if progress is not None:
+                    ok = progress(layer=li, tile=ti, n_layers=L,
+                                  n_tiles=T)
+                    if ok is False:
+                        cancelled = True
+                        break
+            if cancelled:
+                break
+            h_full, x_full = h_next, x_next
+            # close the layer's virtual state from the tile partials —
+            # the scene-wide psums #2/#3, applied exactly once
+            Hv, X = virt_fn(gcls[li], Hv, X, jnp.asarray(tx_l),
+                            jnp.asarray(vf_l), jnp.asarray(ct_l))
+        loop_s = max(time.perf_counter() - t_loop, 1e-9)
+        return h_full, x_full, min(stall_s / loop_s, 1.0), cancelled

@@ -749,9 +749,11 @@ class Gateway:
     # ---- tiled predicts (above the ladder cap) ---------------------------
     @staticmethod
     def _tiled_stats(out: dict) -> dict:
-        return {
+        stats = {
             "tiles": out.get("tiles"),
             "layers": out.get("layers"),
+            "devices": out.get("devices", 1),
+            "rounds": out.get("rounds"),
             "padded_nodes": out.get("padded_nodes"),
             "halo_fraction": round(float(out.get("halo_fraction", 0.0)), 6),
             "work_imbalance": round(float(out.get("work_imbalance", 0.0)), 4),
@@ -759,6 +761,11 @@ class Gateway:
             "prep_ms": out.get("prep_ms"),
             "compute_ms": out.get("total_ms"),
         }
+        # mesh-round extras (serve/mesh_tiled.py) when devices > 1
+        for key in ("round_ms", "halo_gather_ms", "round_imbalance"):
+            if key in out:
+                stats[key] = round(float(out[key]), 4)
+        return stats
 
     def _predict_tiled(self, h, name: str, entry, payload: dict, graph: dict,
                        encoding: str, rid, t0) -> int:
@@ -821,9 +828,10 @@ class Gateway:
     def _tiled_streamed(self, h, name: str, entry, graph: dict,
                         encoding: str, rid, t0, session) -> int:
         """``POST .../predict?stream=1`` above the ladder cap: one NDJSON
-        progress line per completed tile, then a final line carrying the
-        prediction. A client disconnect cancels the executor at the next
-        tile boundary."""
+        progress line per completed tile (sequential) or per completed
+        ROUND of D tiles (serve.tiled.devices > 1), then a final line
+        carrying the prediction. A client disconnect cancels the executor
+        at the next tile/round boundary."""
         sink = StreamSink()
         fut, status = self._submit_guarded(
             h, lambda: entry.queue.submit_tiled(graph, request_id=rid,
@@ -850,12 +858,14 @@ class Gateway:
                         break
                     continue
                 if kind == "chunk":
+                    # per-tile lines from the sequential executor carry
+                    # "tile"; per-ROUND lines from the mesh executor
+                    # (serve.tiled.devices > 1) carry "round"/"n_rounds"
                     info = dict(b or {})
-                    self._write_chunk(h, json.dumps({
-                        "layer": info.get("layer"),
-                        "tile": info.get("tile"),
-                        "n_layers": info.get("n_layers"),
-                        "n_tiles": info.get("n_tiles")}) + "\n")
+                    self._write_chunk(h, json.dumps(
+                        {k: info[k] for k in
+                         ("layer", "tile", "round", "n_layers", "n_tiles",
+                          "n_rounds") if k in info}) + "\n")
                 elif kind == "done":
                     out = a or {}
                     pred = out.get("prediction")

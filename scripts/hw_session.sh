@@ -358,6 +358,28 @@ EOF
   cp /tmp/bench_tiled_last.json \
      "docs/artifacts/bench_tiled_$(date -u +%Y%m%dT%H%M%S).json"
 }
+# 0b3. multi-chip tiled leg (serve/mesh_tiled.py): the SAME giant scene at
+#      D=1 and D=min(8, chips, tiles) device-parallel rounds — the first
+#      real-hardware scaling_efficiency for the round scheduler. The check
+#      requires the sweep to have actually run (devices > 1, rounds < tiles)
+#      and the D-device throughput to beat the sequential anchor — on real
+#      chips parallel rounds must not lose (CPU gets no such gate; virtual
+#      devices share one host).
+tiled_mesh_leg_and_check() {
+  BENCH_TILED_DEVICES=8 python bench.py --layout tiled \
+    | tee /tmp/bench_tiled_mesh_last.json
+  python - <<'EOF' || return 1
+import json
+line = [l for l in open('/tmp/bench_tiled_mesh_last.json') if l.strip().startswith('{')][-1]
+rec = json.loads(line)
+raise SystemExit(0 if rec['value'] > 0 and rec['devices'] > 1
+                 and rec['tiled_rounds'] < rec['tiles']
+                 and rec['value'] > rec['seq_nodes_per_sec'] else 1)
+EOF
+  mkdir -p docs/artifacts
+  cp /tmp/bench_tiled_mesh_last.json \
+     "docs/artifacts/bench_tiled_mesh_$(date -u +%Y%m%dT%H%M%S).json"
+}
 # 0c. input-pipeline leg (data/stream.py): streamed-shard prefetch vs
 #     blocking put, graphs/s + data/stall_s fractions on THIS host's disk.
 #     The check requires the prefetch stall to not exceed the blocking stall
@@ -376,12 +398,13 @@ EOF
      "docs/artifacts/bench_io_$(date -u +%Y%m%dT%H%M%S).json"
 }
 export -f mesh3d_leg_and_check fused_leg_and_check stack_leg_and_check \
-          tiled_leg_and_check io_leg_and_check \
+          tiled_leg_and_check tiled_mesh_leg_and_check io_leg_and_check \
           bench_and_check  # run_bounded's bash -c needs them
 run_bounded bench_fused fused_leg_and_check
 run_bounded bench_fused_stack stack_leg_and_check
 run_bounded bench_mesh3d mesh3d_leg_and_check
 run_bounded bench_tiled tiled_leg_and_check
+run_bounded bench_tiled_mesh tiled_mesh_leg_and_check
 run_bounded bench_io io_leg_and_check
 # 1. headline bench: auto races fused / plain-cumsum stacks / plain-scatter
 #    anchor in child processes (bench.RACE_ORDER) and reports the fastest
